@@ -1,0 +1,20 @@
+"""distributed_llama_tpu — a TPU-native distributed LLM inference framework.
+
+Capability parity target: the reference distributed-llama engine (C++/TCP
+tensor-parallel CPU inference; see /root/repo/SURVEY.md), re-designed from
+scratch for TPU: JAX/XLA for the compute graph, Pallas for quantized kernels,
+`jax.sharding` meshes + XLA collectives (ICI/DCN) for distribution.
+
+Top-level layout:
+  quants          — Q40/Q80 block quantization (file + device formats)
+  formats         — `.m` model-file and `.t` tokenizer-file readers/writers
+  tokenizer       — BPE tokenizer, sampler, chat templates, stop detection
+  models          — model configs + functional forward passes (llama/mixtral/grok1)
+  ops             — rmsnorm/rope/attention/quantized-matmul (XLA + Pallas)
+  parallel        — device meshes, sharding specs, sequence parallelism
+  runtime         — engine (jitted prefill/decode), KV cache, weight loader
+  server          — OpenAI-compatible HTTP API
+  apps            — CLI (inference / generate / chat / worker)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+"""Binary file formats: `.m` model files and `.t` tokenizer files.
+
+Byte-compatible with the reference formats so converted models are
+interchangeable (reference: src/transformer.cpp:12-148, src/tokenizer.cpp:39-148,
+converter/writer.py:109-143, converter/tokenizer-writer.py).
+"""
+
+from distributed_llama_tpu.formats.model_file import (  # noqa: F401
+    ArchType,
+    HiddenAct,
+    ModelSpec,
+    ModelFileReader,
+    ModelFileWriter,
+    RopeType,
+    read_spec,
+    tensor_layout,
+)
+from distributed_llama_tpu.formats.tokenizer_file import (  # noqa: F401
+    TokenizerData,
+    read_tokenizer_file,
+    write_tokenizer_file,
+)

@@ -1,0 +1,163 @@
+"""`.m` / `.t` file format round-trip tests."""
+
+import io
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.formats import (
+    ArchType,
+    HiddenAct,
+    ModelFileReader,
+    ModelFileWriter,
+    ModelSpec,
+    RopeType,
+    TokenizerData,
+    read_spec,
+    read_tokenizer_file,
+    tensor_layout,
+    write_tokenizer_file,
+)
+from distributed_llama_tpu.quants import FloatType
+
+
+def tiny_spec(**kw) -> ModelSpec:
+    defaults = dict(
+        arch_type=ArchType.LLAMA,
+        dim=64,
+        hidden_dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        vocab_size=96,
+        seq_len=128,
+        hidden_act=HiddenAct.SILU,
+        rope_theta=10000.0,
+        weights_float_type=FloatType.Q80,
+    )
+    defaults.update(kw)
+    return ModelSpec(**defaults)
+
+
+def write_random_model(path, spec, seed=0):
+    rng = np.random.default_rng(seed)
+    tensors = {}
+    with open(path, "wb") as f:
+        w = ModelFileWriter(f, spec)
+        for entry in list(w.remaining()):
+            t = rng.standard_normal(entry.shape).astype(np.float32) * 0.02
+            tensors[entry.name] = t
+            w.write_tensor(t, entry.name)
+        w.finish()
+    return tensors
+
+
+def test_spec_roundtrip(tmp_path):
+    spec = tiny_spec()
+    path = tmp_path / "m.m"
+    write_random_model(path, spec)
+    got = read_spec(str(path))
+    assert got.arch_type == spec.arch_type
+    assert got.dim == spec.dim
+    assert got.hidden_dim == spec.hidden_dim
+    assert got.n_layers == spec.n_layers
+    assert got.n_heads == spec.n_heads
+    assert got.n_kv_heads == spec.n_kv_heads
+    assert got.vocab_size == spec.vocab_size
+    assert got.seq_len == spec.seq_len
+    assert got.weights_float_type == FloatType.Q80
+    assert got.kv_dim == 32
+    assert got.head_size == 16
+    assert got.resolved_rope_type() == RopeType.LLAMA
+
+
+def test_tensor_roundtrip(tmp_path):
+    spec = tiny_spec(weights_float_type=FloatType.F32)
+    path = tmp_path / "m.m"
+    tensors = write_random_model(path, spec, seed=1)
+    r = ModelFileReader(str(path))
+    for name, t in tensors.items():
+        np.testing.assert_allclose(r.tensor(name), t, rtol=0, atol=0)
+
+
+def test_tensor_rows_matches_full_read(tmp_path):
+    spec = tiny_spec(weights_float_type=FloatType.Q40)
+    path = tmp_path / "m.m"
+    write_random_model(path, spec, seed=2)
+    r = ModelFileReader(str(path))
+    full = r.tensor("layers.0.q")
+    rows = r.tensor_rows("layers.0.q", 16, 48)
+    np.testing.assert_array_equal(full[16:48], rows)
+
+
+def test_moe_layout(tmp_path):
+    spec = tiny_spec(arch_type=ArchType.MIXTRAL, n_experts=4, n_active_experts=2)
+    names = [e.name for e in tensor_layout(spec)]
+    assert "layers.0.moe_router" in names
+    assert "layers.0.experts.3.down" in names
+    assert "layers.0.gate" not in names
+    # order matches the reference loader (src/transformer.cpp:505-516)
+    i_router = names.index("layers.0.moe_router")
+    assert names[i_router + 1] == "layers.0.experts.0.up"
+    assert names[i_router + 2] == "layers.0.experts.0.gate"
+    assert names[i_router + 3] == "layers.0.experts.0.down"
+    path = tmp_path / "moe.m"
+    write_random_model(path, spec, seed=3)
+    r = ModelFileReader(str(path))
+    assert r.tensor("layers.1.experts.2.up").shape == (128, 64)
+
+
+def test_grok_layout_has_extra_norms():
+    spec = tiny_spec(arch_type=ArchType.GROK1, n_experts=8, n_active_experts=2, hidden_act=HiddenAct.GELU)
+    names = [e.name for e in tensor_layout(spec)]
+    assert "layers.0.rms_moe" in names
+    assert "layers.1.rms_ffn2" in names
+
+
+def test_quantized_model_read(tmp_path):
+    spec = tiny_spec(weights_float_type=FloatType.Q40)
+    path = tmp_path / "q.m"
+    tensors = write_random_model(path, spec, seed=4)
+    r = ModelFileReader(str(path))
+    # embedding is always F32 (reference: src/transformer.cpp:236)
+    np.testing.assert_array_equal(r.tensor("embedding"), tensors["embedding"])
+    q = r.tensor("layers.0.q")
+    assert np.max(np.abs(q - tensors["layers.0.q"])) < 0.02
+
+
+def test_seq_len_clamp():
+    spec = tiny_spec()
+    clamped = spec.clamp_seq_len(64)
+    assert clamped.seq_len == 64
+    assert clamped.orig_seq_len == 128
+    unclamped = spec.clamp_seq_len(None)
+    assert unclamped.seq_len == 128
+
+
+def test_tokenizer_roundtrip():
+    data = TokenizerData(
+        vocab=[b"<s>", b"</s>", b"hello", b" world", bytes([0xE2, 0x96, 0x81])],
+        scores=[0.0, 0.0, -1.5, -2.0, -3.0],
+        bos_id=0,
+        eos_id=1,
+        chat_eos_id=1,
+        chat_template="{% for m in messages %}{{ m.content }}{% endfor %}",
+        chat_stop="<|eot|>",
+    )
+    buf = io.BytesIO()
+    write_tokenizer_file(buf, data)
+    buf.seek(0)
+    import tempfile, os
+
+    with tempfile.NamedTemporaryFile(delete=False, suffix=".t") as f:
+        f.write(buf.getvalue())
+        path = f.name
+    try:
+        got = read_tokenizer_file(path)
+    finally:
+        os.unlink(path)
+    assert got.vocab == data.vocab
+    assert got.scores == pytest.approx(data.scores)
+    assert got.bos_id == 0 and got.eos_id == 1 and got.chat_eos_id == 1
+    assert got.chat_template == data.chat_template
+    assert got.chat_stop == data.chat_stop

@@ -1,0 +1,123 @@
+"""Quantization tests.
+
+Mirrors the reference's quants-test bounds (reference: src/quants-test.cpp:7-52
+— Q80 round-trip error ≤ 0.0043) and the converter writer-test
+(reference: converter/writer-test.py).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.quants import (
+    QK,
+    FloatType,
+    dequantize_q40,
+    dequantize_q80,
+    deserialize_tensor,
+    parse_float_type,
+    q40_from_bytes,
+    q40_to_bytes,
+    q80_from_bytes,
+    q80_to_bytes,
+    quantize_q40,
+    quantize_q80,
+    serialize_tensor,
+    tensor_bytes,
+)
+
+
+def rand(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n) * 2).astype(np.float32)
+
+
+@pytest.mark.parametrize("n", [1024, 768, 2752])
+def test_q80_roundtrip_error(n):
+    # the reference test's fixed 0.0043 bound (src/quants-test.cpp:36-44)
+    # assumes inputs in [0,1); the exact bound is half the per-block scale
+    x = rand(n)
+    qs, scales = quantize_q80(x)
+    y = dequantize_q80(qs, scales)
+    # half the scale (rounding) + f16 rounding of the stored scale itself
+    bound = np.repeat(scales.astype(np.float32) * 0.5 + 1e-4, QK) + np.abs(x) * 2**-10
+    assert np.all(np.abs(x - y) <= bound)
+    # and reproduce the reference bound on reference-range inputs
+    x01 = (rand(n, seed=9) % 1.0).astype(np.float32)
+    qs, scales = quantize_q80(x01)
+    assert np.max(np.abs(x01 - dequantize_q80(qs, scales))) <= 0.0044
+
+
+@pytest.mark.parametrize("n", [32, 1024, 2752])
+def test_q40_roundtrip_error(n):
+    x = rand(n, seed=1)
+    qs, scales = quantize_q40(x)
+    y = dequantize_q40(qs, scales)
+    # interior points round to within |scale|/2; the extreme of each block can
+    # clip by a full |scale| (q grid is [-8..7], asymmetric)
+    scale_per_val = np.repeat(np.abs(scales.astype(np.float32)), QK)
+    assert np.all(np.abs(x - y) <= scale_per_val * 1.0 + np.abs(x) * 2**-10 + 1e-6)
+
+
+def test_q40_wire_roundtrip():
+    x = rand(4096, seed=2)
+    qs, scales = quantize_q40(x)
+    buf = q40_to_bytes(qs, scales)
+    assert len(buf) == tensor_bytes(FloatType.Q40, 4096)
+    qs2, scales2 = q40_from_bytes(buf, 4096)
+    assert np.array_equal(qs.reshape(qs2.shape), qs2)
+    assert np.array_equal(scales.reshape(-1), scales2)
+    np.testing.assert_allclose(dequantize_q40(qs2, scales2), dequantize_q40(qs, scales).reshape(-1))
+
+
+def test_q80_wire_roundtrip():
+    x = rand(2048, seed=3)
+    qs, scales = quantize_q80(x)
+    buf = q80_to_bytes(qs, scales)
+    assert len(buf) == tensor_bytes(FloatType.Q80, 2048)
+    qs2, scales2 = q80_from_bytes(buf, 2048)
+    assert np.array_equal(qs.reshape(qs2.shape), qs2)
+    assert np.array_equal(scales.reshape(-1), scales2)
+
+
+def test_q40_known_block():
+    """Hand-computed block: constant ramp -8..8 maps onto the nibble grid."""
+    x = np.linspace(-8, 8, QK).astype(np.float32)
+    qs, scales = quantize_q40(x)
+    y = dequantize_q40(qs, scales)
+    # sign-preserving absmax: max side dominant => delta = 8/-8 = -1
+    assert abs(float(scales[0])) == 1.0
+    assert np.max(np.abs(x - y)) <= 1.01
+
+
+def test_exact_zero_block():
+    x = np.zeros(64, dtype=np.float32)
+    for quant, dequant in [(quantize_q40, dequantize_q40), (quantize_q80, dequantize_q80)]:
+        qs, scales = quant(x)
+        np.testing.assert_array_equal(dequant(qs, scales), x)
+
+
+def test_serialize_roundtrip_all_types():
+    x = rand(512, seed=4)
+    for ft in FloatType:
+        buf = serialize_tensor(x, ft)
+        assert len(buf) == tensor_bytes(ft, 512)
+        y = deserialize_tensor(buf, ft, 512)
+        tol = {FloatType.F32: 0, FloatType.F16: 2e-3, FloatType.Q40: 0.5, FloatType.Q80: 0.05}[ft]
+        assert np.max(np.abs(x - y)) <= tol
+
+
+def test_parse_float_type():
+    assert parse_float_type("q40") == FloatType.Q40
+    assert parse_float_type("F32") == FloatType.F32
+    with pytest.raises(ValueError):
+        parse_float_type("q4k")
+
+
+def test_batch_quantize_2d():
+    x = rand(4 * 256, seed=5).reshape(4, 256)
+    qs, scales = quantize_q80(x)
+    assert qs.shape == (4, 256 // QK, QK)
+    y = dequantize_q80(qs, scales)
+    assert y.shape == (4, 256)
+    bound = np.abs(scales.astype(np.float32))[..., None] * 0.5 + np.abs(x.reshape(4, -1, QK)) * 2**-10 + 1e-4
+    assert np.all(np.abs(x.reshape(4, -1, QK) - (y.reshape(4, -1, QK))) <= bound)
