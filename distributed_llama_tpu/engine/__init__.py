@@ -1,0 +1,5 @@
+"""Runtime engine: weight loading, KV-cached generation, stats."""
+
+from distributed_llama_tpu.engine.engine import InferenceEngine
+
+__all__ = ["InferenceEngine"]

@@ -1,0 +1,101 @@
+"""Load `.m` weights into the stacked pytree consumed by the model functions.
+
+The reference root node mmaps the file and streams per-matrix slices to
+workers over TCP (reference: src/transformer.cpp:432-616). On TPU the same
+file is read once per host; matrices are transposed to (d_in, d_out) so the
+hot matmul is ``x @ W`` with no transposes in the compiled program, layers are
+stacked on a leading axis for ``lax.scan``, and the result is `device_put`
+(optionally with a NamedSharding so XLA places each shard directly).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llama_tpu.formats.model_file import ArchType, ModelFileReader, ModelSpec
+from distributed_llama_tpu.models.config import LlamaConfig, config_from_spec
+from distributed_llama_tpu.models.rope import build_rope_table
+
+Params = dict[str, Any]
+
+
+def _t(x: np.ndarray, dtype) -> np.ndarray:
+    """File stores [d_out, d_in] (y = W @ x); we store [d_in, d_out]."""
+    return np.ascontiguousarray(x.T).astype(dtype)
+
+
+def load_params(
+    reader: ModelFileReader,
+    cfg: LlamaConfig | None = None,
+    dtype=jnp.bfloat16,
+    rows: tuple[int, int] | None = None,
+) -> Params:
+    """Build the host-side params pytree (numpy, not yet on device).
+
+    dtype applies to the matmul weights; embeddings and norm scales stay f32
+    (they are F32 in the file too — reference: src/transformer.cpp:296-310).
+    """
+    spec = reader.spec
+    cfg = cfg or config_from_spec(spec)
+    np_dtype = np.dtype(dtype)  # ml_dtypes registers bfloat16 with numpy
+
+    def cast(x: np.ndarray) -> np.ndarray:
+        return x.astype(np_dtype)
+
+    layers: dict[str, list[np.ndarray]] = {}
+
+    def add(key: str, value) -> None:
+        layers.setdefault(key, []).append(value)
+
+    for l in range(cfg.n_layers):
+        p = f"layers.{l}."
+        add("q", cast(_t(reader.tensor(p + "q"), np.float32)))
+        add("k", cast(_t(reader.tensor(p + "k"), np.float32)))
+        add("v", cast(_t(reader.tensor(p + "v"), np.float32)))
+        add("wo", cast(_t(reader.tensor(p + "wo"), np.float32)))
+        add("rms_att", reader.tensor(p + "rms_att").astype(np.float32))
+        add("rms_ffn", reader.tensor(p + "rms_ffn").astype(np.float32))
+        if cfg.is_moe:
+            add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
+            ups, gates, downs = [], [], []
+            for e in range(cfg.n_experts):
+                ep = f"{p}experts.{e}."
+                ups.append(_t(reader.tensor(ep + "up"), np.float32))
+                gates.append(_t(reader.tensor(ep + "gate"), np.float32))
+                downs.append(_t(reader.tensor(ep + "down"), np.float32))
+            add("moe_up", cast(np.stack(ups)))
+            add("moe_gate", cast(np.stack(gates)))
+            add("moe_down", cast(np.stack(downs)))
+        else:
+            add("gate", cast(_t(reader.tensor(p + "gate"), np.float32)))
+            add("down", cast(_t(reader.tensor(p + "down"), np.float32)))
+            add("up", cast(_t(reader.tensor(p + "up"), np.float32)))
+        if cfg.arch == ArchType.GROK1:
+            add("rms_moe", reader.tensor(p + "rms_moe").astype(np.float32))
+            add("rms_ffn2", reader.tensor(p + "rms_ffn2").astype(np.float32))
+
+    # stays numpy (ml_dtypes handles bf16): placement happens once, in the
+    # engine, via device_put — plain or with a NamedSharding under TP — so no
+    # full copy ever lands on a single device's HBM first
+    stacked = {k: np.stack(vs) for k, vs in layers.items()}
+    return {
+        "embedding": reader.tensor("embedding").astype(np.float32),
+        "layers": stacked,
+        "rms_final": reader.tensor("rms_final").astype(np.float32),
+        "wcls": cast(_t(reader.tensor("wcls"), np.float32)),
+        "rope_table": build_rope_table(cfg),
+    }
+
+
+def load_model(
+    path: str, dtype=jnp.bfloat16, max_seq_len: int | None = None, **cfg_overrides
+) -> tuple[ModelSpec, LlamaConfig, Params]:
+    reader = ModelFileReader(path)
+    spec = reader.spec.clamp_seq_len(max_seq_len)
+    cfg = config_from_spec(spec, **cfg_overrides)
+    params = load_params(reader, cfg, dtype=dtype)
+    reader.close()
+    return spec, cfg, params

@@ -189,8 +189,13 @@ def write_header(f: BinaryIO, spec: ModelSpec) -> int:
     return header_size
 
 
-def read_spec(path: str) -> ModelSpec:
-    """Parse the `.m` header (reference: src/transformer.cpp:12-148)."""
+def read_spec(path: str, weights_float_type: FloatType | None = None) -> ModelSpec:
+    """Parse the `.m` header (reference: src/transformer.cpp:12-148).
+
+    ``weights_float_type`` must be given for legacy-magic files, whose header
+    has no dtype field — mirroring the reference's CLI-supplied
+    `--weights-float-type` (reference: src/transformer.cpp:28-43,
+    src/app.cpp:141-143)."""
     import os
 
     fields: dict = dict(
@@ -217,7 +222,9 @@ def read_spec(path: str) -> ModelSpec:
             ) = vals
             fields["arch_type"] = ArchType(magic)
             fields["header_size"] = 4 + 36
-            fields["weights_float_type"] = None
+            fields["weights_float_type"] = (
+                None if weights_float_type is None else int(weights_float_type)
+            )
         elif magic == MAGIC_KV:
             (header_size,) = struct.unpack("<i", f.read(4))
             n_ints = (header_size - 8) // 4
@@ -330,9 +337,14 @@ class ModelFileReader:
     (and per-row-range) random access over a single mmap.
     """
 
-    def __init__(self, path: str, spec: ModelSpec | None = None):
+    def __init__(
+        self,
+        path: str,
+        spec: ModelSpec | None = None,
+        weights_float_type: FloatType | None = None,
+    ):
         self.path = path
-        self.spec = spec or read_spec(path)
+        self.spec = spec or read_spec(path, weights_float_type=weights_float_type)
         self.entries = {e.name: e for e in tensor_layout(self.spec)}
         last = max(self.entries.values(), key=lambda e: e.offset)
         expected = last.offset + last.nbytes

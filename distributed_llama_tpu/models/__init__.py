@@ -1,0 +1,14 @@
+"""Model architectures (Llama, Mixtral, Grok-1) as pure JAX functions.
+
+The reference expresses a model as a flat task list executed in lock-step by a
+thread pool (reference: src/llama2-tasks.cpp:241-298); here a model is a pure
+``forward`` function over a pytree of stacked per-layer weights, scanned with
+``jax.lax.scan`` and compiled once by XLA. Collective points (the reference's
+sync tasks) are `psum`s keyed by an optional mesh axis name, so the same code
+runs single-chip (axis None) and tensor-parallel (inside shard_map).
+"""
+
+from distributed_llama_tpu.models.config import LlamaConfig, config_from_spec
+from distributed_llama_tpu.models.llama import forward_tokens, init_cache
+
+__all__ = ["LlamaConfig", "config_from_spec", "forward_tokens", "init_cache"]

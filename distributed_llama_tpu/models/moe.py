@@ -1,0 +1,84 @@
+"""Mixture-of-experts FFN (Mixtral, Grok-1).
+
+Parity with the reference's MoE task chain (reference:
+src/grok1-tasks.cpp:56-263, composed into Mixtral at
+src/mixtral-tasks.cpp:25-44): router matmul → softmax → top-k →
+renormalized weights → per-expert SwiGLU → weighted sum of expert downs.
+
+TPU-first design notes:
+* The reference routes on the root with scalar code and broadcasts indexes
+  (grok1-tasks.cpp:69-126); here routing is `jax.lax.top_k` inside the same
+  jitted program — replicated across TP shards, so no broadcast exists.
+* Experts are TP-sliced exactly like the reference (every shard holds a
+  1/n-of-hidden slice of *all* experts — transformer.cpp:335-353), so the
+  expert weighted-sum needs the same single psum as the dense FFN.
+* Expert mixing is dense one-hot (every expert computed, weighted by a
+  mostly-zero [T, E] matrix). For the single-token decode path this trades
+  (E/k)× MXU flops for zero dynamic gathers; a top-k gathered variant is the
+  planned Pallas optimization (SURVEY.md §7 stage 5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.formats.model_file import ArchType
+from distributed_llama_tpu.models.config import LlamaConfig
+
+
+def router_weights(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Array:
+    """[T, E] mixing weights: softmax over all experts, top-k selected, the
+    selected weights renormalized to sum to 1 (reference:
+    src/grok1-tasks.cpp:62-114)."""
+    logits = jnp.einsum(
+        "td,de->te",
+        xn.astype(jnp.float32),
+        router.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, cfg.n_active_experts)
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32)  # [T, k, E]
+    return jnp.einsum("tk,tke->te", top_vals, one_hot)
+
+
+def moe_ffn(cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None) -> jax.Array:
+    """Expert-mixed SwiGLU. ``xn``: [T, dim] (already normed);
+    lp["moe_up"/"moe_gate"]: [E, dim, hidden_local], lp["moe_down"]:
+    [E, hidden_local, dim]; returns [T, dim] (psum'd over TP shards)."""
+    from distributed_llama_tpu.models.llama import _activation  # no cycle at call time
+
+    weights = router_weights(cfg, xn, lp["router"])  # [T, E] f32
+    xc = xn.astype(lp["moe_up"].dtype)
+    gate = jnp.einsum(
+        "td,edh->teh", xc, lp["moe_gate"], preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    up = jnp.einsum(
+        "td,edh->teh", xc, lp["moe_up"], preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    h = _activation(gate, cfg.hidden_act) * up  # [T, E, Hl] f32
+    down = jnp.einsum(
+        "teh,ehd->ted", h.astype(lp["moe_down"].dtype), lp["moe_down"],
+        preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST,
+    )
+    out = jnp.einsum("te,ted->td", weights, down, precision=jax.lax.Precision.HIGHEST)
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
+
+
+def moe_block(cfg: LlamaConfig, x: jax.Array, lp, axis_name: str | None) -> jax.Array:
+    """The FFN half of a MoE block, *after* the attention residual has been
+    applied by the caller. Handles the Mixtral-vs-Grok norm placement."""
+    from distributed_llama_tpu.models.llama import rmsnorm
+
+    if cfg.arch == ArchType.GROK1:
+        xn = rmsnorm(x, lp["rms_moe"])
+        out = moe_ffn(cfg, xn, lp, axis_name)
+        return x + rmsnorm(out.astype(x.dtype), lp["rms_ffn2"])
+    xn = rmsnorm(x, lp["rms_ffn"])
+    return x + moe_ffn(cfg, xn, lp, axis_name).astype(x.dtype)

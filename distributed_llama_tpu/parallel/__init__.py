@@ -1,0 +1,11 @@
+"""Parallelism: device meshes, tensor-parallel sharding, multi-host setup.
+
+The reference's only inter-node strategy is tensor parallelism over raw TCP
+(SURVEY.md §2); here TP is a `shard_map` over a named mesh axis with XLA
+collectives riding ICI/DCN, and the same mesh machinery extends to dp/sp/ep
+axes (see distributed_llama_tpu.parallel.context for sequence parallelism).
+"""
+
+from distributed_llama_tpu.parallel.tensor_parallel import TensorParallelForward
+
+__all__ = ["TensorParallelForward"]

@@ -1,0 +1,163 @@
+"""Tensor parallelism: one SPMD program over a ``tp`` mesh axis.
+
+Layout parity with the reference's slicing math (reference:
+src/commands.cpp:11-108):
+
+  * q/k/v, w1(gate)/w3(up) — output-dim sharded  (RowMatmulSlice, :11-43)
+  * wo, w2(down)           — input-dim sharded   (ColMatmulSlice, :45-73)
+  * attention heads        — ``n_heads/tp`` per shard (MultiHeadAttSlice, :104-108)
+  * KV cache               — sharded on the KV-head axis (KvCacheSlice, :97-102)
+  * MoE experts            — every shard holds a 1/tp hidden-slice of all
+                             experts (transformer.cpp:335-353)
+  * wcls                   — output(vocab)-dim sharded + all-gather (the
+                             reference keeps logits root-only instead)
+
+What the reference does with 4 TCP hops per layer (broadcast xb, gather xbv,
+broadcast xb, gather xbv — README.md:135-147) is here exactly 2 psums per
+layer (after wo and after w2) riding ICI, with the activation broadcast
+replaced by replicated-by-construction compute.
+
+The divisibility constraint mirrors ``nSlices <= nKvHeads``
+(reference: src/transformer.cpp:108-111): tp must divide n_kv_heads (and
+n_heads, hidden_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_llama_tpu.models import llama
+from distributed_llama_tpu.models.config import LlamaConfig
+
+try:  # jax >= 0.4.35 exposes shard_map at jax.shard_map
+    from jax import shard_map as _shard_map_mod  # type: ignore
+
+    shard_map = _shard_map_mod
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    """The sharding-divisibility constraint, enforced like the reference's
+    nSlices checks (reference: src/transformer.cpp:105-111)."""
+    if tp & (tp - 1):
+        raise ValueError(f"tp must be a power of two, got {tp}")
+    for name, value in (
+        ("n_heads", cfg.n_heads),
+        ("n_kv_heads", cfg.n_kv_heads),
+        ("hidden_dim", cfg.hidden_dim),
+    ):
+        if value % tp != 0:
+            raise ValueError(f"tp={tp} must divide {name}={value}")
+
+
+def layer_param_specs(cfg: LlamaConfig) -> dict[str, P]:
+    """PartitionSpecs for the stacked per-layer tree (leading axis = layer)."""
+    specs: dict[str, P] = {
+        "q": P(None, None, "tp"),  # [L, D, H*hd] — output sharded
+        "k": P(None, None, "tp"),
+        "v": P(None, None, "tp"),
+        "wo": P(None, "tp", None),  # [L, H*hd, D] — input sharded
+        "rms_att": P(None, None),
+        "rms_ffn": P(None, None),
+    }
+    if cfg.is_moe:
+        specs.update(
+            router=P(None, None, None),  # [L, D, E] replicated
+            moe_up=P(None, None, None, "tp"),  # [L, E, D, Hl]
+            moe_gate=P(None, None, None, "tp"),
+            moe_down=P(None, None, "tp", None),  # [L, E, Hl, D]
+        )
+    else:
+        specs.update(
+            gate=P(None, None, "tp"),  # [L, D, hidden]
+            down=P(None, "tp", None),  # [L, hidden, D]
+            up=P(None, None, "tp"),
+        )
+    if cfg.arch.name == "GROK1":
+        specs.update(rms_moe=P(None, None), rms_ffn2=P(None, None))
+    return specs
+
+
+def param_specs(cfg: LlamaConfig, shard_vocab: bool) -> dict[str, Any]:
+    return {
+        "embedding": P(None, None),
+        "layers": layer_param_specs(cfg),
+        "rms_final": P(None),
+        "wcls": P(None, "tp") if shard_vocab else P(None, None),
+        "rope_table": P(None, None, None),
+    }
+
+
+CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
+
+
+class TensorParallelForward:
+    """Jitted shard_map'd forward over a 1-D ``tp`` mesh."""
+
+    def __init__(self, cfg: LlamaConfig, tp: int, devices=None):
+        validate_tp(cfg, tp)
+        self.cfg = cfg
+        self.tp = tp
+        if devices is None:
+            devices = jax.devices()[:tp]
+        if len(devices) < tp:
+            raise ValueError(f"need {tp} devices, have {len(devices)}")
+        self.mesh = Mesh(mesh_utils.create_device_mesh((tp,), devices=devices), ("tp",))
+        self.shard_vocab = cfg.vocab_size % tp == 0
+        self._specs = param_specs(cfg, self.shard_vocab)
+
+        fn = functools.partial(self._step, cfg)
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), CACHE_SPEC, P()),
+            out_specs=(P(), CACHE_SPEC),
+            check_vma=False,
+        )
+        self._jitted = jax.jit(mapped, donate_argnums=(2,))
+
+    @staticmethod
+    def _step(cfg, params, tokens, cache, pos):
+        logits, new_cache = llama.forward_tokens(
+            cfg, params, tokens, cache, pos, axis_name="tp"
+        )
+        if logits.shape[-1] != cfg.vocab_size:
+            # wcls was vocab-sharded: reassemble full logits on every shard
+            logits = jax.lax.all_gather(logits, "tp", axis=1, tiled=True)
+        return logits, new_cache
+
+    # ------------------------------------------------------------------
+
+    def shard_params(self, host_params) -> Any:
+        # explicit recursion: PartitionSpec is a tuple subclass, so tree.map
+        # over the spec tree would descend into the specs themselves
+        def rec(p, s):
+            if isinstance(p, dict):
+                return {k: rec(p[k], s[k]) for k in p}
+            return jax.device_put(p, NamedSharding(self.mesh, s))
+
+        return rec(host_params, self._specs)
+
+    def init_cache(self, dtype=jnp.float32):
+        shape = (
+            self.cfg.n_layers,
+            2,
+            self.cfg.seq_len,
+            self.cfg.n_kv_heads,
+            self.cfg.head_size,
+        )
+        sharding = NamedSharding(self.mesh, CACHE_SPEC)
+        per_shard = shape[:3] + (shape[3] // self.tp,) + shape[4:]
+        zeros = np.zeros(per_shard, dtype)
+        return jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
+
+    def forward(self, params, tokens, cache, pos):
+        return self._jitted(params, jnp.asarray(tokens), cache, jnp.asarray(pos))

@@ -10,11 +10,17 @@ guarantees by importing conftest first.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may point at a TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+# the container's sitecustomize registers a TPU plugin and pins
+# jax_platforms before this file runs; re-pin to CPU for the test mesh
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

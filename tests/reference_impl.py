@@ -1,0 +1,188 @@
+"""Independent numpy implementation of the llama forward pass, used as the
+golden oracle for the JAX model (the analogue of the reference's hard-coded
+golden vectors in src/llama2-tasks-test.cpp, but computed rather than pasted,
+so any shape works).
+
+Written deliberately in the reference's conventions: weights [d_out, d_in],
+y = W @ x, one token at a time, python loops over heads — slow and obviously
+correct.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct, ModelSpec, RopeType
+
+
+def rmsnorm(x: np.ndarray, w: np.ndarray, eps: float = 1e-5) -> np.ndarray:
+    ms = np.mean(x.astype(np.float64) ** 2)
+    return (w * (x / np.sqrt(ms + eps))).astype(np.float32)
+
+
+def silu(x):
+    return x / (1.0 + np.exp(-x))
+
+
+def gelu_tanh(x):
+    return 0.5 * x * (1.0 + np.tanh(0.7978845608028654 * x * (1.0 + 0.044715 * x * x)))
+
+
+def rope_interleaved(v: np.ndarray, pos: int, head_size: int, theta: float, freq_scale=None):
+    """v: flat [n_heads*head_size]; rotates pairs (2j, 2j+1) per head
+    (reference: src/commands.cpp:147-179)."""
+    out = v.copy()
+    n = v.shape[0]
+    for i in range(0, n, 2):
+        head_dim = i % head_size
+        freq = 1.0 / (theta ** (head_dim / head_size))
+        if freq_scale is not None:
+            freq = freq_scale(freq)
+        val = pos * freq
+        fcr, fci = np.cos(val), np.sin(val)
+        v0, v1 = v[i], v[i + 1]
+        out[i] = v0 * fcr - v1 * fci
+        out[i + 1] = v0 * fci + v1 * fcr
+    return out
+
+
+def rope_neox(v: np.ndarray, pos: int, head_size: int, theta: float):
+    """Falcon-style: pairs (j, j+half) (reference: src/commands.cpp:235-257)."""
+    out = v.copy()
+    half = head_size // 2
+    n_heads = v.shape[0] // head_size
+    for h in range(n_heads):
+        for j in range(half):
+            freq = 1.0 / (theta ** (2.0 * j / head_size))
+            val = pos * freq
+            fcr, fci = np.cos(val), np.sin(val)
+            q0 = v[h * head_size + j]
+            q1 = v[h * head_size + j + half]
+            out[h * head_size + j] = q0 * fcr - q1 * fci
+            out[h * head_size + j + half] = q0 * fci + q1 * fcr
+    return out
+
+
+def llama3_freq_scale(spec: ModelSpec):
+    def scale(freq: float) -> float:
+        wavelen = 2.0 * np.pi / freq
+        low_wavelen = spec.rope_scaling_orig_max_seq_len / spec.rope_scaling_low_freq_factor
+        high_wavelen = spec.rope_scaling_orig_max_seq_len / spec.rope_scaling_high_freq_factor
+        if wavelen < high_wavelen:
+            return freq
+        if wavelen > low_wavelen:
+            return freq / spec.rope_scaling_factor
+        smooth = (spec.rope_scaling_orig_max_seq_len / wavelen - spec.rope_scaling_low_freq_factor) / (
+            spec.rope_scaling_high_freq_factor - spec.rope_scaling_low_freq_factor
+        )
+        return (1 - smooth) * freq / spec.rope_scaling_factor + smooth * freq
+
+    return scale
+
+
+class NumpyLlama:
+    """Token-at-a-time forward with explicit KV cache."""
+
+    def __init__(self, spec: ModelSpec, tensors: dict[str, np.ndarray]):
+        self.spec = spec
+        self.t = {k: v.astype(np.float32) for k, v in tensors.items()}
+        kv_dim = spec.kv_dim
+        self.key_cache = np.zeros((spec.n_layers, spec.seq_len, kv_dim), np.float32)
+        self.value_cache = np.zeros((spec.n_layers, spec.seq_len, kv_dim), np.float32)
+
+    def _rope(self, v: np.ndarray, pos: int) -> np.ndarray:
+        spec = self.spec
+        rt = spec.resolved_rope_type()
+        if rt == RopeType.FALCON:
+            return rope_neox(v, pos, spec.head_size, spec.rope_theta)
+        if rt == RopeType.LLAMA3_1 and spec.rope_scaling_factor:
+            return rope_interleaved(
+                v, pos, spec.head_size, spec.rope_theta, llama3_freq_scale(spec)
+            )
+        return rope_interleaved(v, pos, spec.head_size, spec.rope_theta)
+
+    def _attention(self, l: int, xn: np.ndarray, pos: int) -> np.ndarray:
+        spec, t = self.spec, self.t
+        hd = spec.head_size
+        q = t[f"layers.{l}.q"] @ xn
+        k = t[f"layers.{l}.k"] @ xn
+        v = t[f"layers.{l}.v"] @ xn
+        q = self._rope(q, pos)
+        k = self._rope(k, pos)
+        self.key_cache[l, pos] = k
+        self.value_cache[l, pos] = v
+        kv_mul = spec.n_heads // spec.n_kv_heads
+        out = np.zeros(spec.dim, np.float32)
+        for h in range(spec.n_heads):
+            qh = q[h * hd : (h + 1) * hd]
+            kvh = h // kv_mul
+            scores = np.array(
+                [
+                    qh @ self.key_cache[l, p, kvh * hd : (kvh + 1) * hd] / np.sqrt(hd)
+                    for p in range(pos + 1)
+                ]
+            )
+            scores = np.exp(scores - scores.max())
+            att = scores / scores.sum()
+            for p in range(pos + 1):
+                out[h * hd : (h + 1) * hd] += (
+                    att[p] * self.value_cache[l, p, kvh * hd : (kvh + 1) * hd]
+                )
+        return self.t[f"layers.{l}.wo"] @ out
+
+    def _ffn(self, l: int, xn: np.ndarray) -> np.ndarray:
+        t = self.t
+        h1 = t[f"layers.{l}.gate"] @ xn
+        h2 = t[f"layers.{l}.up"] @ xn
+        act = gelu_tanh if self.spec.hidden_act == HiddenAct.GELU else silu
+        return t[f"layers.{l}.down"] @ (act(h1) * h2)
+
+    def _moe_ffn(self, l: int, xn: np.ndarray, x_for_router: np.ndarray) -> np.ndarray:
+        """Top-k expert mixing (reference: src/grok1-tasks.cpp:56-228).
+        Router logits → softmax → top-k → renormalized weights."""
+        spec, t = self.spec, self.t
+        logits = t[f"layers.{l}.moe_router"] @ x_for_router
+        e = np.exp(logits - logits.max())
+        probs = e / e.sum()
+        top = np.argsort(-probs)[: spec.n_active_experts]
+        w = probs[top]
+        w = w / w.sum()
+        act = gelu_tanh if spec.hidden_act == HiddenAct.GELU else silu
+        out = np.zeros(spec.dim, np.float32)
+        for weight, ei in zip(w, top):
+            h1 = t[f"layers.{l}.experts.{ei}.gate"] @ xn
+            h2 = t[f"layers.{l}.experts.{ei}.up"] @ xn
+            out += weight * (t[f"layers.{l}.experts.{ei}.down"] @ (act(h1) * h2))
+        return out
+
+    def forward(self, token: int, pos: int) -> np.ndarray:
+        spec, t = self.spec, self.t
+        x = t["embedding"][token].copy()
+        if spec.arch_type == ArchType.GROK1:
+            x *= 78.38367176906169
+        for l in range(spec.n_layers):
+            xn = rmsnorm(x, t[f"layers.{l}.rms_att"])
+            att_out = self._attention(l, xn, pos)
+            if spec.arch_type == ArchType.GROK1:
+                # grok: attention output is rmsnorm'd with rmsFfn *before* the
+                # residual add (grok1-tasks.cpp:16-41), the MoE input norm uses
+                # rmsMoe (43-54), and the MoE output is rmsnorm'd with rmsFfn2
+                # before its residual add (245-263)
+                x = x + rmsnorm(att_out, t[f"layers.{l}.rms_ffn"])
+                xn = rmsnorm(x, t[f"layers.{l}.rms_moe"])
+                moe_out = self._moe_ffn(l, xn, xn)
+                x = x + rmsnorm(moe_out, t[f"layers.{l}.rms_ffn2"])
+            elif spec.n_experts > 0:
+                # mixtral: plain llama residual + top-k MoE (mixtral-tasks.cpp:24-44)
+                x = x + att_out
+                xn = rmsnorm(x, t[f"layers.{l}.rms_ffn"])
+                x = x + self._moe_ffn(l, xn, xn)
+            else:
+                x = x + att_out
+                xn = rmsnorm(x, t[f"layers.{l}.rms_ffn"])
+                x = x + self._ffn(l, xn)
+        x = rmsnorm(x, t["rms_final"])
+        logits = t["wcls"] @ x
+        if spec.arch_type == ArchType.GROK1:
+            logits = logits * 0.5773502691896257
+        return logits

@@ -1,0 +1,311 @@
+"""Tokenizer / sampler / chat-template / EosDetector tests.
+
+The EosDetector and ChatTemplate cases mirror the reference's
+src/tokenizer-test.cpp:14-176 one for one; encode/decode tests build a
+synthetic sentencepiece-style vocab (the reference has no encode tests — we
+add coverage it lacks, per SURVEY.md §4)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.formats.tokenizer_file import (
+    TokenizerData,
+    read_tokenizer_file,
+    write_tokenizer_file,
+)
+from distributed_llama_tpu.tokenizer import (
+    ChatItem,
+    ChatTemplate,
+    ChatTemplateType,
+    EosDetector,
+    EosDetectorResult,
+    Sampler,
+    Tokenizer,
+    XorshiftRng,
+    detect_chat_template,
+)
+
+EOS_ID = 10000
+
+NOT_EOS = EosDetectorResult.NOT_EOS
+EOS = EosDetectorResult.EOS
+MAYBE_EOS = EosDetectorResult.MAYBE_EOS
+
+
+def make_sentencepiece_like_tokenizer() -> Tokenizer:
+    """Tiny sentencepiece-style vocab: <unk>, <s>, </s>, 256 byte tokens,
+    then words/subwords with merge scores."""
+    vocab: list[bytes] = [b"<unk>", b"<s>", b"</s>"]
+    scores: list[float] = [0.0, 0.0, 0.0]
+    for b in range(256):
+        vocab.append(f"<0x{b:02X}>".encode())
+        scores.append(0.0)
+    extra = [
+        (b" ", -1.0),
+        (b"h", -2.0),
+        (b"e", -2.0),
+        (b"l", -2.0),
+        (b"o", -2.0),
+        (b"he", -3.0),
+        (b"ll", -4.0),
+        (b"hell", -5.0),
+        (b"hello", -6.0),
+        (b" hello", -7.0),
+        (b"w", -2.0),
+        (b"r", -2.0),
+        (b"d", -2.0),
+        (b"wo", -3.0),
+        (b"wor", -4.0),
+        (b"worl", -5.0),
+        (b"world", -6.5),
+        (b" world", -7.5),
+    ]
+    for tok, score in extra:
+        vocab.append(tok)
+        scores.append(score)
+    return Tokenizer(
+        TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=2)
+    )
+
+
+class TestEncode:
+    def test_greedy_merge_to_words(self):
+        tok = make_sentencepiece_like_tokenizer()
+        ids = tok.encode("hello world", add_bos=True)
+        assert ids[0] == tok.bos_id
+        texts = [tok.vocab[i] for i in ids[1:]]
+        assert texts == [b" hello", b" world"]
+
+    def test_byte_fallback_plus_3(self):
+        tok = make_sentencepiece_like_tokenizer()
+        # \x01 is not in the vocab as a piece → byte-fallback token 1+3
+        ids = tok.encode("\x01")
+        assert ids[-1] == 1 + 3
+
+    def test_utf8_codepoint_fallback(self):
+        tok = make_sentencepiece_like_tokenizer()
+        text = "é"  # 2-byte codepoint not in vocab → two byte tokens
+        ids = tok.encode(text)
+        raw = text.encode("utf-8")
+        assert ids[-2:] == [raw[0] + 3, raw[1] + 3]
+
+    def test_add_bos_eos(self):
+        tok = make_sentencepiece_like_tokenizer()
+        ids = tok.encode("hello", add_bos=True, add_eos=True)
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+
+    def test_empty_text_no_dummy_prefix(self):
+        tok = make_sentencepiece_like_tokenizer()
+        assert tok.encode("", add_bos=True) == [tok.bos_id]
+
+    def test_decode_round_trip(self):
+        tok = make_sentencepiece_like_tokenizer()
+        ids = tok.encode("hello world", add_bos=True)
+        # leading dummy-prefix space is stripped after BOS on decode
+        assert tok.decode(ids) == "hello world"
+
+    def test_decode_raw_byte_tokens(self):
+        tok = make_sentencepiece_like_tokenizer()
+        # token 3+65 is <0x41> → 'A'
+        assert tok.decode_piece(5, 3 + 65) == b"A"
+
+    def test_file_round_trip(self, tmp_path):
+        tok = make_sentencepiece_like_tokenizer()
+        path = tmp_path / "test.t"
+        with open(path, "wb") as f:
+            write_tokenizer_file(f, tok.data)
+        tok2 = Tokenizer.from_file(str(path))
+        assert tok2.vocab == tok.vocab
+        assert tok2.encode("hello world") == tok.encode("hello world")
+
+
+class TestXorshift:
+    def test_known_sequence_is_deterministic(self):
+        rng = XorshiftRng(12345)
+        a = [rng.next_u32() for _ in range(4)]
+        rng2 = XorshiftRng(12345)
+        b = [rng2.next_u32() for _ in range(4)]
+        assert a == b
+        assert all(0 <= v < 2**32 for v in a)
+
+    def test_f32_in_unit_interval(self):
+        rng = XorshiftRng(7)
+        for _ in range(100):
+            v = rng.next_f32()
+            assert 0.0 <= v < 1.0
+
+
+class TestSampler:
+    def test_greedy(self):
+        s = Sampler(vocab_size=5, temperature=0.0)
+        logits = np.array([0.1, 2.0, -1.0, 0.5, 1.9], dtype=np.float32)
+        assert s.sample(logits) == 1
+
+    def test_temperature_deterministic_per_seed(self):
+        logits = np.random.RandomState(0).randn(100).astype(np.float32)
+        s1 = Sampler(vocab_size=100, temperature=0.8, topp=0.9, seed=42)
+        s2 = Sampler(vocab_size=100, temperature=0.8, topp=0.9, seed=42)
+        assert [s1.sample(logits.copy()) for _ in range(10)] == [
+            s2.sample(logits.copy()) for _ in range(10)
+        ]
+
+    def test_topp_restricts_to_nucleus(self):
+        # one dominant token: top-p 0.5 must always return it
+        logits = np.full(50, -10.0, dtype=np.float32)
+        logits[7] = 10.0
+        s = Sampler(vocab_size=50, temperature=1.0, topp=0.5, seed=3)
+        assert all(s.sample(logits.copy()) == 7 for _ in range(20))
+
+    def test_mult_covers_distribution(self):
+        logits = np.zeros(4, dtype=np.float32)
+        s = Sampler(vocab_size=4, temperature=1.0, topp=0.0, seed=11)
+        seen = {s.sample(logits.copy()) for _ in range(200)}
+        assert seen == {0, 1, 2, 3}
+
+
+class TestChatTemplate:
+    LLAMA3_TPL = "{% set content = '<|start_header_id|>' %}<|start_header_id|>..."
+    CHATML_TPL = "{{bos_token}}<|im_start|>..."
+    ZEPHYR_TPL = "<|user|>\n..."
+    LLAMA2_TPL = "[INST] ..."
+
+    def test_detection(self):
+        assert detect_chat_template(self.LLAMA3_TPL) == ChatTemplateType.LLAMA3
+        assert detect_chat_template(self.CHATML_TPL) == ChatTemplateType.CHATML
+        assert detect_chat_template(self.ZEPHYR_TPL) == ChatTemplateType.ZEPHYR
+        assert detect_chat_template(self.LLAMA2_TPL) == ChatTemplateType.LLAMA2
+
+    def test_detection_unknown_raises(self):
+        with pytest.raises(ValueError):
+            detect_chat_template("completely custom")
+        with pytest.raises(ValueError):
+            detect_chat_template(None)
+
+    def test_llama3_render(self):
+        t = ChatTemplate(ChatTemplateType.LLAMA3, None, "<eot>")
+        out = t.generate([ChatItem("system", "sys"), ChatItem("user", "hi")])
+        assert out == (
+            "<|start_header_id|>system<|end_header_id|>\n\nsys<eot>"
+            "<|start_header_id|>user<|end_header_id|>\n\nhi<eot>"
+            "<|start_header_id|>assistant<|end_header_id|>\n\n"
+        )
+
+    def test_llama2_render_system_fold(self):
+        t = ChatTemplate(ChatTemplateType.LLAMA2, None, "</s>")
+        out = t.generate([ChatItem("system", "sys"), ChatItem("user", "hi")])
+        assert out == "[INST] <<SYS>>\nsys\n<</SYS>>\n\nhi [/INST]</s>"
+
+    def test_chatml_render(self):
+        t = ChatTemplate(ChatTemplateType.CHATML, None, "<eos>")
+        out = t.generate([ChatItem("user", "hi")])
+        assert out == "<|im_start|>user\nhi<|im_end|>\n<|im_start|>assistant\n"
+
+    def test_zephyr_render(self):
+        t = ChatTemplate(ChatTemplateType.ZEPHYR, None, "</s>")
+        out = t.generate([ChatItem("user", "hi")])
+        assert out == "<|user|>\nhi</s>\n<|assistant|>\n"
+
+
+class TestEosDetectorWithPadding:
+    """Mirrors reference src/tokenizer-test.cpp:27-100."""
+
+    def make(self):
+        return EosDetector(EOS_ID, ["<eos>", "<stop>"], padding_left=1, padding_right=1)
+
+    def test_eos_across_pieces(self):
+        d = self.make()
+        assert d.append(1, "<") == MAYBE_EOS
+        assert d.append(2, "eo") == MAYBE_EOS
+        assert d.append(3, "s>") == EOS
+        assert d.get_delta() is None
+
+    def test_stop_with_trailing_space(self):
+        d = self.make()
+        assert d.append(1, "<") == MAYBE_EOS
+        assert d.append(2, "stop") == MAYBE_EOS
+        assert d.append(3, "> ") == EOS
+        assert d.get_delta() is None
+
+    def test_space_not_eos(self):
+        d = self.make()
+        assert d.append(1, " ") == NOT_EOS
+        assert d.get_delta() == b" "
+
+    def test_left_padding_keeps_prefix(self):
+        d = self.make()
+        assert d.append(1, "!<") == MAYBE_EOS
+        assert d.append(2, "eos") == MAYBE_EOS
+        assert d.append(3, "> ") == EOS
+        assert d.get_delta() == b"!"
+
+    def test_false_alarm_flushes_all(self):
+        d = self.make()
+        assert d.append(1, "<eo") == MAYBE_EOS
+        assert d.append(2, "s>XY") == NOT_EOS
+        assert d.get_delta() == b"<eos>XY"
+
+    def test_eos_token_mid_buffer(self):
+        d = self.make()
+        assert d.append(1, "<eo") == MAYBE_EOS
+        assert d.append(EOS_ID, "<eos>") == EOS
+        assert d.get_delta() == b"<eo"
+
+    def test_eos_token_alone(self):
+        d = self.make()
+        assert d.append(EOS_ID, "<eos>") == EOS
+        assert d.get_delta() is None
+
+
+class TestEosDetectorLongPadding:
+    """Mirrors reference src/tokenizer-test.cpp:103-135."""
+
+    def make(self):
+        return EosDetector(EOS_ID, ["|end|"], padding_left=5, padding_right=5)
+
+    def test_lipsum(self):
+        d = self.make()
+        assert d.append(1, "lipsum") == NOT_EOS
+        assert d.get_delta() == b"lipsum"
+
+    def test_lorem(self):
+        d = self.make()
+        assert d.append(1, "lorem") == NOT_EOS
+        assert d.get_delta() == b"lorem"
+
+    def test_partial_then_mismatch(self):
+        d = self.make()
+        assert d.append(1, "lorem|") == MAYBE_EOS
+        assert d.append(2, "enQ") == NOT_EOS
+        assert d.get_delta() == b"lorem|enQ"
+
+
+class TestEosDetectorNoPadding:
+    """Mirrors reference src/tokenizer-test.cpp:137-176."""
+
+    def make(self):
+        return EosDetector(EOS_ID, ["<eos>"], padding_left=0, padding_right=0)
+
+    def test_exact(self):
+        d = self.make()
+        assert d.append(1, "<") == MAYBE_EOS
+        assert d.append(2, "eo") == MAYBE_EOS
+        assert d.append(3, "s>") == EOS
+        assert d.get_delta() is None
+
+    def test_leading_space_breaks_match(self):
+        d = self.make()
+        assert d.append(1, " <") == NOT_EOS
+        assert d.get_delta() == b" <"
+
+    def test_trailing_char_breaks_match(self):
+        d = self.make()
+        assert d.append(1, "<eos") == MAYBE_EOS
+        assert d.append(2, "> ") == NOT_EOS
+        assert d.get_delta() == b"<eos> "
+
+    def test_eos_token(self):
+        d = self.make()
+        assert d.append(EOS_ID, "<eos>") == EOS
+        assert d.get_delta() is None
