@@ -1,0 +1,1 @@
+"""User-facing apps: CLI (inference/generate/chat/worker) and helpers."""

@@ -1,0 +1,253 @@
+"""`dllama-tpu` CLI: inference / generate / chat / worker modes.
+
+Command surface parity with the reference's dllama app
+(reference: src/apps/dllama/dllama.cpp:223-254, arg parsing
+src/app.cpp:28-113), adapted to the TPU runtime:
+
+* ``--workers host:port...`` (TCP worker list) becomes ``--tp N`` (shard over
+  N local chips) plus multi-host flags (``--coordinator``, ``--num-hosts``,
+  ``--host-id``) that drive ``jax.distributed`` — the SPMD equivalent of the
+  reference's root/worker split where every host runs the *same* program.
+* ``--nthreads`` is accepted but ignored: the thread pool's job is done by
+  XLA inside one chip (SURVEY.md §2, intra-node thread parallelism).
+* ``--buffer-float-type`` is accepted but advisory: the wire-quantization it
+  controls in the reference (Q80 activations over TCP, src/tasks.cpp:96-135)
+  does not exist here — activations never leave the chip mesh except over ICI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from distributed_llama_tpu.tokenizer import (
+    ChatItem,
+    ChatTemplate,
+    ChatTemplateType,
+    EosDetector,
+    EosDetectorResult,
+    Sampler,
+    Tokenizer,
+    chat_stops,
+    is_safe_piece,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllama-tpu")
+    p.add_argument("mode", choices=["inference", "generate", "chat", "worker"])
+    p.add_argument("--model", required=True)
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--max-seq-len", type=int, default=None)
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel shards (chips)")
+    p.add_argument(
+        "--dtype", choices=["bf16", "f32"], default="bf16", help="on-device weight dtype"
+    )
+    p.add_argument("--chat-template", default=None,
+                   choices=[None, "llama2", "llama3", "zephyr", "chatml"])
+    # accepted-for-parity flags (see module docstring)
+    p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--weights-float-type", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--kv-cache-storage", default=None, help=argparse.SUPPRESS)
+    # multi-host (jax.distributed) participation
+    p.add_argument("--coordinator", default=None, help="host:port of jax.distributed coordinator")
+    p.add_argument("--num-hosts", type=int, default=1)
+    p.add_argument("--host-id", type=int, default=0)
+    return p
+
+
+def make_engine(args):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.engine import InferenceEngine
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    engine = InferenceEngine(
+        args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp
+    )
+    tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
+    seed = args.seed if args.seed is not None else int(time.time())
+    sampler = Sampler(
+        vocab_size=engine.cfg.vocab_size,
+        temperature=args.temperature,
+        topp=args.topp,
+        seed=seed,
+    )
+    return engine, tokenizer, sampler
+
+
+def _print(s: str) -> None:
+    sys.stdout.write(s)
+    sys.stdout.flush()
+
+
+def generate(args, benchmark: bool) -> None:
+    """The generate/inference loop (reference: src/apps/dllama/dllama.cpp:17-94).
+
+    TPU-first deviation: the prompt is prefilled in one batched forward
+    instead of token-by-token; the per-token stats lines cover the decode
+    phase, prefill is reported as its own line.
+    """
+    if args.prompt is None:
+        raise SystemExit("Prompt is required")
+    engine, tokenizer, sampler = make_engine(args)
+    add_bos = engine.cfg.arch.name != "GROK1"  # (reference: dllama.cpp:26)
+    prompt_tokens = tokenizer.encode(args.prompt, add_bos=add_bos)
+
+    n_prompt = len(prompt_tokens)
+    if n_prompt < 1:
+        raise SystemExit("Expected at least 1 prompt token")
+
+    total_start = time.perf_counter()
+    logits = engine.prefill(prompt_tokens)
+    if benchmark:
+        stats = engine.stats[-1]
+        _print(f"🔷 P {stats.generation_ms:5.0f} ms ({n_prompt} prompt tokens) ")
+    _print(tokenizer.decode(prompt_tokens))
+    if benchmark:
+        _print("\n")
+
+    token = prompt_tokens[-1]
+    generated = 0
+    while True:
+        next_token = sampler.sample(logits)
+        if next_token == tokenizer.bos_id:
+            break  # BOS delimits sequences (reference: dllama.cpp:68-71)
+        stats = engine.stats[-1]
+        piece = tokenizer.decode_piece(token, next_token)
+        if benchmark:
+            _print(
+                f"🔶 G {stats.generation_ms:4.0f} ms I {stats.inference_ms:4.0f} ms "
+                f"T {stats.transfer_ms:4.0f} ms "
+            )
+        if is_safe_piece(piece):
+            _print(piece.decode("utf-8", errors="replace"))
+        if benchmark:
+            _print("\n")
+        generated += 1
+        token = next_token
+        if engine.pos >= args.steps:
+            break
+        logits = engine.decode_step(token)
+
+    avg = engine.avg_stats()
+    total_ms = (time.perf_counter() - total_start) * 1000.0
+    n = max(1, len(engine.stats))
+    _print("\n")
+    _print(f"Generated tokens:    {generated}\n")
+    _print(f"Avg tokens / second: {1000.0 * n / max(total_ms, 1e-9):.2f}\n")
+    _print(f"Avg generation time: {avg.generation_ms:.2f} ms\n")
+    _print(f"Avg inference time:  {avg.inference_ms:.2f} ms\n")
+    _print(f"Avg transfer time:   {avg.transfer_ms:.2f} ms\n")
+
+
+def chat(args) -> None:
+    """Multi-turn REPL (reference: src/apps/dllama/dllama.cpp:111-203)."""
+    engine, tokenizer, sampler = make_engine(args)
+    stops = chat_stops(tokenizer)
+    template_type = args.chat_template or ChatTemplateType.UNKNOWN
+    template = ChatTemplate(template_type, tokenizer.chat_template, stops[0])
+    max_stop = max(len(s) for s in stops)
+
+    items: list[ChatItem] = []
+    sys_prompt = input("💻 System prompt (optional): ")
+    if sys_prompt:
+        items.append(ChatItem("system", sys_prompt))
+
+    seq_len = engine.cfg.seq_len
+    while engine.pos < seq_len:
+        user = ""
+        while not user:
+            user = input("\n👱 User\n> ")
+        items.append(ChatItem("user", user))
+        prompt = template.generate(items, append_generation_prompt=True)
+        items = []  # only deltas are fed each turn (reference keeps full list; we re-feed deltas against the live KV cache)
+        tokens = tokenizer.encode(prompt, add_bos=engine.pos == 0)
+
+        budget = seq_len - engine.pos
+        tokens = tokens[:budget]
+        logits = engine.prefill(tokens)
+        _print("\n🤖 Assistant\n")
+
+        detector = EosDetector(
+            {tokenizer.chat_eos_id}, stops, padding_left=max_stop, padding_right=max_stop
+        )
+        prev = tokens[-1]
+        while engine.pos < seq_len:
+            token = sampler.sample(logits)
+            piece = tokenizer.decode_piece(prev, token)
+            res = detector.append(token, piece if is_safe_piece(piece) else b"")
+            if res in (EosDetectorResult.NOT_EOS, EosDetectorResult.EOS):
+                delta = detector.get_delta()
+                if delta:
+                    _print(delta.decode("utf-8", errors="replace"))
+                detector.clear()
+            if res == EosDetectorResult.EOS:
+                break
+            logits = engine.decode_step(token)
+            prev = token
+    _print("\n(end of context)\n")
+
+
+def worker(args) -> None:
+    """Multi-host participant: joins the jax.distributed mesh and runs the
+    same SPMD program as the root host.
+
+    The reference's worker blocks on a TCP accept and receives streamed
+    weight slices (reference: dllama.cpp:205-221, transformer.cpp:541-616);
+    here every host loads its own shard of the `.m` file and the collective
+    mesh is formed by jax.distributed.
+    """
+    if args.coordinator is None:
+        raise SystemExit(
+            "worker mode needs --coordinator host:port, --num-hosts and --host-id "
+            "(every host runs the same program; start the root with the same flags "
+            "and --host-id 0)"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_hosts,
+        process_id=args.host_id,
+    )
+    # after initialization, every host must execute the same SPMD program
+    # with identical flags (the multi-host contract: same --prompt, --steps,
+    # --tp on all hosts). Default the prompt so a bare worker participates
+    # instead of dying; the root should pass the same explicit flags here.
+    if args.prompt is None:
+        args.prompt = "Hello world"
+    generate(args, benchmark=False)
+
+
+def main(argv=None) -> None:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # some environments pin jax_platforms in sitecustomize, which beats
+        # the env var; re-assert the user's explicit choice
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    args = build_parser().parse_args(argv)
+    if args.mode == "inference":
+        generate(args, benchmark=True)
+    elif args.mode == "generate":
+        generate(args, benchmark=False)
+    elif args.mode == "chat":
+        chat(args)
+    elif args.mode == "worker":
+        worker(args)
+
+
+if __name__ == "__main__":
+    main()

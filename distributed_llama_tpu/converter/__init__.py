@@ -1,0 +1,11 @@
+"""Offline conversion toolchain: HF safetensors / Meta .pth → `.m`,
+tokenizers → `.t`, plus the named-model launcher registry.
+
+Mirrors the reference's converter/ scripts (convert-hf.py, convert-llama.py,
+convert-tokenizer-{hf,llama2,llama3}.py, launch.py) as an importable package
+with CLI entry points.
+"""
+
+from distributed_llama_tpu.converter.hf import convert_hf, permute_qk
+
+__all__ = ["convert_hf", "permute_qk"]

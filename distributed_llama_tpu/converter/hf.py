@@ -1,0 +1,214 @@
+"""HF safetensors checkpoint → `.m` (llama / mistral / mixtral).
+
+Parity with reference converter/convert-hf.py: the tensor plan order matches
+the C++ loader (convert-hf.py:52-90), Q/K projections are permuted from the
+HF neox pair layout to the interleaved rope layout (:12-15), and the header
+carries rope scaling when config.json has it (:190-196).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from distributed_llama_tpu.formats.model_file import (
+    ArchType,
+    HiddenAct,
+    ModelFileWriter,
+    ModelSpec,
+    RopeType,
+)
+from distributed_llama_tpu.quants import FloatType
+
+ARCH_BY_MODEL_TYPE = {
+    "llama": ArchType.LLAMA,
+    "mistral": ArchType.LLAMA,
+    "mixtral": ArchType.MIXTRAL,
+}
+
+HIDDEN_ACT = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}
+
+
+def permute_qk(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """HF neox rope layout → interleaved pair layout
+    (reference: converter/convert-hf.py:12-15). ``w``: [n_heads*head, dim]."""
+    d = w.shape[0]
+    return (
+        w.reshape(n_heads, 2, d // n_heads // 2, *w.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(w.shape)
+    )
+
+
+def spec_from_hf_config(config: dict, float_type: FloatType) -> ModelSpec:
+    arch = ARCH_BY_MODEL_TYPE.get(config["model_type"])
+    if arch is None:
+        raise ValueError(f"unsupported model type: {config['model_type']}")
+    n_experts = int(config.get("num_local_experts") or 0)
+    n_active = int(
+        config.get("num_active_local_experts") or config.get("num_experts_per_tok") or 0
+    )
+    spec = ModelSpec(
+        arch_type=arch,
+        dim=config["hidden_size"],
+        hidden_dim=config["intermediate_size"],
+        n_layers=config["num_hidden_layers"],
+        n_heads=config["num_attention_heads"],
+        n_kv_heads=config["num_key_value_heads"],
+        vocab_size=config["vocab_size"],
+        seq_len=config["max_position_embeddings"],
+        n_experts=n_experts,
+        n_active_experts=n_active,
+        hidden_act=HIDDEN_ACT[config["hidden_act"]],
+        rope_theta=float(config.get("rope_theta") or 10000.0),
+        weights_float_type=float_type,
+    )
+    # The converter permutes Q/K into the interleaved-pair layout, so the
+    # correct rope for every converted HF model is LLAMA (interleaved). The
+    # reference converter leaves the header rope type unset, which makes the
+    # reference runtime default MIXTRAL files to falcon/neox rope
+    # (src/transformer.cpp:88-96) on permuted weights — a layout mismatch
+    # that silently degrades its Mixtral outputs. Writing the key explicitly
+    # is honored by both runtimes.
+    spec.rope_type = RopeType.LLAMA
+    scaling = config.get("rope_scaling")
+    if scaling is not None:
+        if scaling.get("rope_type") not in ("llama3",):
+            raise ValueError(f"unsupported rope scaling type: {scaling.get('rope_type')}")
+        # header stores int32 values, truncated like the reference converter
+        # (convert-hf.py:190-196)
+        spec.rope_type = RopeType.LLAMA3_1
+        spec.rope_scaling_factor = int(scaling["factor"])
+        spec.rope_scaling_low_freq_factor = int(scaling["low_freq_factor"])
+        spec.rope_scaling_high_freq_factor = int(scaling["high_freq_factor"])
+        spec.rope_scaling_orig_max_seq_len = int(scaling["original_max_position_embeddings"])
+    return spec
+
+
+class _LazySafetensors:
+    """Multi-file lazy tensor lookup (reference: convert-hf.py:26-44 keeps one
+    file open at a time; checkpoints are usually ordered, so misses are rare)."""
+
+    def __init__(self, files: list[str]):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.files = files
+        self._index: dict[str, int] = {}
+        self._open_idx: int | None = None
+        self._open = None
+
+    def _load(self, idx: int):
+        if self._open_idx == idx:
+            return
+        if self._open is not None:
+            del self._open
+        self._open = self._safe_open(self.files[idx], framework="np", device="cpu")
+        self._open_idx = idx
+        for key in self._open.keys():
+            self._index[key] = idx
+
+    def get(self, name: str) -> np.ndarray:
+        if self._open is None:
+            self._load(0)
+        while name not in self._index:
+            nxt = (self._open_idx or 0) + 1
+            if nxt >= len(self.files):
+                # full scan fallback
+                for i in range(len(self.files)):
+                    self._load(i)
+                if name not in self._index:
+                    raise KeyError(f"tensor {name} not found in checkpoint")
+                break
+            self._load(nxt)
+        self._load(self._index[name])
+        return np.asarray(self._open.get_tensor(name))
+
+
+def hf_tensor_plan(spec: ModelSpec) -> list[tuple[str, str, bool]]:
+    """[(m_name, hf_name, permute)] in `.m` layout order."""
+    plan: list[tuple[str, str, bool]] = [("embedding", "model.embed_tokens.weight", False)]
+    for l in range(spec.n_layers):
+        hp = f"model.layers.{l}."
+        mp = f"layers.{l}."
+        plan += [
+            (mp + "q", hp + "self_attn.q_proj.weight", True),
+            (mp + "k", hp + "self_attn.k_proj.weight", True),
+            (mp + "v", hp + "self_attn.v_proj.weight", False),
+            (mp + "wo", hp + "self_attn.o_proj.weight", False),
+        ]
+        if spec.n_experts > 0:
+            plan.append((mp + "moe_router", hp + "block_sparse_moe.gate.weight", False))
+            for e in range(spec.n_experts):
+                ep = hp + f"block_sparse_moe.experts.{e}."
+                plan += [
+                    (mp + f"experts.{e}.up", ep + "w3.weight", False),
+                    (mp + f"experts.{e}.gate", ep + "w1.weight", False),
+                    (mp + f"experts.{e}.down", ep + "w2.weight", False),
+                ]
+        else:
+            plan += [
+                (mp + "gate", hp + "mlp.gate_proj.weight", False),
+                (mp + "down", hp + "mlp.down_proj.weight", False),
+                (mp + "up", hp + "mlp.up_proj.weight", False),
+            ]
+        plan += [
+            (mp + "rms_att", hp + "input_layernorm.weight", False),
+            (mp + "rms_ffn", hp + "post_attention_layernorm.weight", False),
+        ]
+    plan += [("rms_final", "model.norm.weight", False), ("wcls", "lm_head.weight", False)]
+    return plan
+
+
+def convert_hf(
+    source_dir: str, float_type: FloatType, output_path: str, progress=print
+) -> ModelSpec:
+    with open(os.path.join(source_dir, "config.json")) as f:
+        config = json.load(f)
+    spec = spec_from_hf_config(config, float_type)
+
+    files = sorted(
+        os.path.join(source_dir, f)
+        for f in os.listdir(source_dir)
+        if f.endswith(".safetensors") and not f.startswith(".")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {source_dir}")
+    src = _LazySafetensors(files)
+
+    tied = config.get("tie_word_embeddings", False)
+    with open(output_path, "wb") as out:
+        writer = ModelFileWriter(out, spec)
+        for m_name, hf_name, permute in hf_tensor_plan(spec):
+            if m_name == "wcls" and tied:
+                tensor = src.get("model.embed_tokens.weight")
+            else:
+                tensor = src.get(hf_name)
+            if permute:
+                heads = spec.n_heads if m_name.endswith(".q") else spec.n_kv_heads
+                tensor = permute_qk(tensor, heads)
+            progress(f"🔶 writing {m_name} {tuple(tensor.shape)}")
+            writer.write_tensor(np.asarray(tensor, dtype=np.float32), m_name)
+        writer.finish()
+    return spec
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    from distributed_llama_tpu.quants import parse_float_type
+
+    p = argparse.ArgumentParser(prog="dllama-tpu-convert-hf")
+    p.add_argument("source", help="folder with config.json + *.safetensors")
+    p.add_argument("float_type", help="f32 | f16 | q40 | q80")
+    p.add_argument("name", help="output model name")
+    args = p.parse_args(argv)
+    out = f"dllama_model_{args.name}_{args.float_type}.m"
+    convert_hf(args.source, parse_float_type(args.float_type), out)
+    print(f"✅ {out} created successfully")
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,14 @@ class InferenceEngine:
         self.pos = 0
         self.stats.clear()
 
+    def rollback(self, pos: int) -> None:
+        """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots
+        beyond ``pos`` are stale but unreachable: attention masks s <= pos and
+        every slot is overwritten before the position pointer crosses it."""
+        if not 0 <= pos <= self.pos:
+            raise ValueError(f"cannot rollback to {pos} from {self.pos}")
+        self.pos = pos
+
     def forward(self, tokens: list[int] | np.ndarray) -> np.ndarray:
         """Run tokens at the current position; returns f32 logits [T, vocab]
         (padded positions stripped). Advances pos by len(tokens)."""
@@ -127,6 +135,47 @@ class InferenceEngine:
     def decode_step(self, token: int) -> np.ndarray:
         """One autoregressive step; returns f32 logits [vocab]."""
         return self.forward([token])[0]
+
+    def generate_on_device(
+        self,
+        first_token: int,
+        n_steps: int,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Generate n_steps tokens in ONE device program (no per-token host
+        round trip). Returns int32 [n_steps]. Falls back to the stepwise path
+        under TP (the sharded decode loop lands with the multi-host work)."""
+        if self.pos + n_steps > self.cfg.seq_len:
+            raise ValueError(f"context overflow: pos {self.pos} + {n_steps}")
+        import jax
+
+        from distributed_llama_tpu.models import sampling
+
+        if self._tp_engine is not None:
+            raise NotImplementedError(
+                "on-device decode loop under TP lands with the multi-host work; "
+                "use decode_step"
+            )
+        start = time.perf_counter()
+        tokens, self.cache = sampling.decode_loop(
+            self.cfg,
+            self.params,
+            jnp.int32(first_token),
+            self.cache,
+            jnp.int32(self.pos),
+            n_steps,
+            float(temperature),
+            float(topp),
+            jax.random.PRNGKey(seed),
+        )
+        tokens = np.asarray(tokens)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        per_token = elapsed_ms / n_steps
+        self.stats.extend([TokenStats(per_token, per_token, 0.0)] * n_steps)
+        self.pos += n_steps
+        return tokens
 
     # ------------------------------------------------------------------
     # Stats (reference: Inference::getStats, src/tasks.cpp:186-189)

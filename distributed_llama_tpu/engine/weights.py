@@ -90,6 +90,84 @@ def load_params(
     }
 
 
+def _synthetic_params(cfg: LlamaConfig, mat, ones, embedding, rope_table) -> Params:
+    """Shared structure for the synthetic-param builders: the single source of
+    truth for the pytree shape, kept in lockstep with load_params. ``mat``,
+    ``ones``, ``embedding`` are array factories (host numpy or on-device)."""
+    D, H, K, hd = cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_size
+    L, F, V = cfg.n_layers, cfg.hidden_dim, cfg.vocab_size
+    layers = {
+        "q": mat(L, D, H * hd),
+        "k": mat(L, D, K * hd),
+        "v": mat(L, D, K * hd),
+        "wo": mat(L, H * hd, D),
+        "rms_att": ones(L, D),
+        "rms_ffn": ones(L, D),
+    }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers.update(
+            router=mat(L, D, E),
+            moe_up=mat(L, E, D, F),
+            moe_gate=mat(L, E, D, F),
+            moe_down=mat(L, E, F, D),
+        )
+    else:
+        layers.update(gate=mat(L, D, F), down=mat(L, F, D), up=mat(L, D, F))
+    if cfg.arch == ArchType.GROK1:
+        layers.update(rms_moe=ones(L, D), rms_ffn2=ones(L, D))
+    return {
+        "embedding": embedding(V, D),
+        "layers": layers,
+        "rms_final": ones(D),
+        "wcls": mat(D, V),
+        "rope_table": rope_table,
+    }
+
+
+def random_params(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0) -> Params:
+    """Synthetic host-side params pytree with the exact structure/shapes of
+    load_params. Used by tests and the multichip dry-run."""
+    rng = np.random.RandomState(seed)
+    np_dtype = np.dtype(dtype)
+
+    def mat(*shape):
+        scale = 1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
+        return (rng.randn(*shape) * scale).astype(np_dtype)
+
+    def ones(*shape):
+        return np.ones(shape, np.float32)
+
+    def embedding(V, D):
+        return (rng.randn(V, D) * 0.02).astype(np.float32)
+
+    return _synthetic_params(cfg, mat, ones, embedding, build_rope_table(cfg))
+
+
+def random_params_on_device(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0) -> Params:
+    """Like :func:`random_params` but generated with jax.random directly on
+    the accelerator — no host RNG time and no host-to-device transfer. Used by
+    the benchmark, where a 7B-parameter tree would otherwise take minutes to
+    synthesize and ship."""
+    import jax
+
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 32))
+
+    def mat(*shape):
+        scale = 1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
+        # generate directly in the target dtype: an f32 intermediate of the
+        # largest stacked tensor would transiently cost 2x its bf16 size
+        return jax.random.normal(next(keys), shape, dtype=dtype) * jnp.asarray(scale, dtype)
+
+    def ones(*shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def embedding(V, D):
+        return jax.random.normal(next(keys), (V, D), dtype=jnp.float32) * 0.02
+
+    return _synthetic_params(cfg, mat, ones, embedding, jnp.asarray(build_rope_table(cfg)))
+
+
 def load_model(
     path: str, dtype=jnp.bfloat16, max_seq_len: int | None = None, **cfg_overrides
 ) -> tuple[ModelSpec, LlamaConfig, Params]:

@@ -1,0 +1,1 @@
+"""Serving surface: OpenAI-compatible HTTP API."""

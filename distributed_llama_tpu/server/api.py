@@ -1,0 +1,309 @@
+"""OpenAI-compatible API server: POST /v1/chat/completions, GET /v1/models.
+
+Behavior parity with the reference's dllama-api
+(reference: src/apps/dllama-api/dllama-api.cpp): SSE streaming chunks
+(:168-185), per-request temperature/seed/max_tokens overrides (:351-380),
+the NaiveCache longest-message-prefix KV reuse (:187-241), single in-flight
+request, and the same response JSON shapes (types.hpp:10-147).
+
+Intentional fixes over the reference:
+* request ``stop`` sequences are actually honored (the reference parses them
+  but its EosDetector is constructed once with only the tokenizer stops,
+  dllama-api.cpp:396-399 — request stops never reach it);
+* the delta prompt is prefilled in one batched forward instead of
+  token-by-token.
+
+Built on stdlib http.server — the reference hand-rolls HTTP on raw sockets
+(dllama-api.cpp:38-147); there is no reason to reproduce that on a host
+runtime that has an HTTP stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from distributed_llama_tpu.tokenizer import (
+    ChatItem,
+    ChatTemplate,
+    ChatTemplateType,
+    EosDetector,
+    EosDetectorResult,
+    Sampler,
+    Tokenizer,
+    chat_stops,
+    is_safe_piece,
+)
+
+MODEL_NAME = "Distributed Model"  # (reference: types.hpp:54, 80)
+
+
+@dataclasses.dataclass
+class CacheItem:
+    end_pos: int
+    role: str
+    content: str
+
+
+class NaiveCache:
+    """Longest-message-prefix chat cache
+    (reference: src/apps/dllama-api/dllama-api.cpp:187-232)."""
+
+    def __init__(self):
+        self.items: list[CacheItem] = []
+
+    def push(self, end_pos: int, role: str, content: str) -> None:
+        self.items.append(CacheItem(end_pos, role, content))
+
+    def clear(self) -> None:
+        self.items.clear()
+
+    def resolve_delta_prompt(self, messages: list[dict]) -> tuple[int, list[dict]]:
+        """Returns (start_pos, remaining_messages)."""
+        n = len(self.items)
+        if n == 0:
+            return 0, messages
+        if len(messages) > n and all(
+            self.items[i].role == messages[i]["role"]
+            and self.items[i].content == messages[i]["content"]
+            for i in range(n)
+        ):
+            return self.items[-1].end_pos, messages[n:]
+        self.clear()
+        return 0, messages
+
+
+class ApiState:
+    def __init__(self, engine, tokenizer: Tokenizer, sampler: Sampler, args):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.sampler = sampler
+        self.args = args
+        stops = chat_stops(tokenizer)
+        self.stops = stops
+        template_type = getattr(args, "chat_template", None) or ChatTemplateType.UNKNOWN
+        self.template = ChatTemplate(template_type, tokenizer.chat_template, stops[0])
+        self.cache = NaiveCache()
+
+    def complete(self, body: dict, send_chunk) -> dict | None:
+        """Run one completion. ``send_chunk(str)`` streams SSE data lines when
+        the request has stream=true (then returns None); otherwise returns the
+        final JSON payload."""
+        engine, tokenizer = self.engine, self.tokenizer
+        params = self._parse(body)
+        stream = params["stream"]
+
+        start_pos, delta_messages = self.cache.resolve_delta_prompt(params["messages"])
+        engine.rollback(min(start_pos, engine.pos))
+        if engine.pos != start_pos:  # cache said resume further than engine state
+            engine.reset()
+            self.cache.clear()  # stale end_pos values no longer map to engine positions
+            start_pos = 0
+            delta_messages = params["messages"]
+
+        items = [ChatItem(m["role"], m["content"]) for m in delta_messages]
+        prompt = self.template.generate(items, append_generation_prompt=True)
+        prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
+        seq_len = engine.cfg.seq_len
+        budget = seq_len - engine.pos
+        prompt_tokens = prompt_tokens[:budget]
+        prompt_end = start_pos + len(prompt_tokens)
+        for m in delta_messages:
+            self.cache.push(prompt_end, m["role"], m["content"])
+
+        max_pos = prompt_end + params["max_tokens"] if params["max_tokens"] > 0 else seq_len
+        max_pos = min(max_pos, seq_len)
+
+        self.sampler.set_temperature(params["temperature"])
+        if params["seed"] is not None:
+            self.sampler.set_seed(params["seed"])
+
+        logits = engine.prefill(prompt_tokens)
+
+        max_stop = max(len(s) for s in self.stops + params["stop"]) if (self.stops or params["stop"]) else 0
+        detector = EosDetector(
+            {tokenizer.chat_eos_id},
+            self.stops + params["stop"],
+            padding_left=max_stop,
+            padding_right=max_stop,
+        )
+
+        buffer = []
+        prev = prompt_tokens[-1]
+        pos = engine.pos
+        while pos < max_pos:
+            token = self.sampler.sample(logits)
+            piece = tokenizer.decode_piece(prev, token)
+            res = detector.append(token, piece if is_safe_piece(piece) else b"")
+            if res in (EosDetectorResult.NOT_EOS, EosDetectorResult.EOS):
+                delta = detector.get_delta()
+                if delta:
+                    text = delta.decode("utf-8", errors="replace")
+                    buffer.append(text)
+                    if stream:
+                        send_chunk(self._chunk_json(text, stop=False))
+                detector.clear()
+            if res == EosDetectorResult.EOS:
+                break
+            logits = engine.decode_step(token)
+            prev = token
+            pos = engine.pos
+
+        content = "".join(buffer)
+        if engine.pos >= seq_len:
+            self.cache.clear()  # (reference: dllama-api.cpp:330-334)
+        else:
+            self.cache.push(engine.pos, "assistant", content)
+
+        if stream:
+            send_chunk(self._chunk_json("", stop=True))
+            send_chunk("[DONE]")
+            return None
+        n_completion = engine.pos - prompt_end
+        return {
+            "id": "cmpl-j0",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": MODEL_NAME,
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_completion,
+                "total_tokens": len(prompt_tokens) + n_completion,
+            },
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": "stop",
+                }
+            ],
+        }
+
+    def _chunk_json(self, delta_text: str, stop: bool) -> str:
+        choice: dict = {"index": 0, "finish_reason": "stop" if stop else ""}
+        choice["delta"] = (
+            {"role": "", "content": ""}
+            if stop
+            else {"role": "assistant", "content": delta_text}
+        )
+        return json.dumps(
+            {
+                "id": "cmpl-c0",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": MODEL_NAME,
+                "choices": [choice],
+            }
+        )
+
+    def _parse(self, body: dict) -> dict:
+        # OpenAI allows stop to be a string, an array, or null
+        stop = body.get("stop", ["<|eot_id|>"])
+        if stop is None:
+            stop = []
+        elif isinstance(stop, str):
+            stop = [stop]
+        return {
+            "messages": [
+                {"role": m["role"], "content": m["content"]} for m in body["messages"]
+            ],
+            "stream": bool(body.get("stream", False)),
+            "temperature": float(body.get("temperature", self.args.temperature)),
+            "seed": body.get("seed"),
+            "max_tokens": int(body.get("max_tokens", -1)),
+            "stop": [s for s in stop if s],
+        }
+
+
+def make_handler(state: ApiState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            print(f"🔷 {self.command} {self.path}")
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                payload = json.dumps(
+                    {
+                        "object": "list",
+                        "data": [
+                            {"id": "dl", "object": "model", "created": 0, "owned_by": "user"}
+                        ],
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            else:
+                self.send_error(404)
+
+        def do_POST(self):
+            if self.path != "/v1/chat/completions":
+                self.send_error(404)
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if body.get("stream"):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def send_chunk(data: str):
+                    self.wfile.write(f"data: {data}\r\n\r\n".encode())
+                    self.wfile.flush()
+
+                state.complete(body, send_chunk)
+                self.close_connection = True
+            else:
+                result = state.complete(body, lambda s: None)
+                payload = json.dumps(result).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+    return Handler
+
+
+def serve(args) -> None:
+    from distributed_llama_tpu.apps.cli import make_engine
+
+    engine, tokenizer, sampler = make_engine(args)
+    state = ApiState(engine, tokenizer, sampler, args)
+    server = HTTPServer(("0.0.0.0", args.port), make_handler(state))
+    print(f"Server URL: http://127.0.0.1:{args.port}/v1/")
+    server.serve_forever()
+
+
+def main(argv=None) -> None:
+    import os
+
+    from distributed_llama_tpu.apps.cli import build_parser
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    parser = build_parser()
+    parser.add_argument("--port", type=int, default=9990)
+    # mode is meaningless here but the shared parser requires it
+    argv = argv if argv is not None else None
+    import sys
+
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if not raw or raw[0] not in ("inference", "generate", "chat", "worker"):
+        raw = ["generate"] + raw
+    args = parser.parse_args(raw)
+    serve(args)
+
+
+if __name__ == "__main__":
+    main()

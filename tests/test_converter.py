@@ -1,0 +1,158 @@
+"""Converter tests: HF checkpoint → .m → our engine vs HF transformers logits.
+
+This is end-to-end parity evidence the reference never had: it validates the
+Q/K permutation (neox → interleaved rope), tensor plan order, and the whole
+forward pass against the upstream implementation the checkpoints come from.
+
+Note: HF models default to rms_norm_eps=1e-6 but this runtime (like the
+reference, src/funcs.cpp:120-122) hardcodes 1e-5, so the test configs pin
+rms_norm_eps=1e-5.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.converter.hf import convert_hf, permute_qk
+from distributed_llama_tpu.converter.tokenizers import convert_hf_tokenizer
+from distributed_llama_tpu.engine import InferenceEngine
+from distributed_llama_tpu.quants import FloatType
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def save_hf_llama(tmp_path, moe=False):
+    common = dict(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=96,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    if moe:
+        config = transformers.MixtralConfig(
+            num_local_experts=4, num_experts_per_tok=2, **common
+        )
+        model = transformers.MixtralForCausalLM(config)
+    else:
+        config = transformers.LlamaConfig(**common)
+        model = transformers.LlamaForCausalLM(config)
+    model = model.eval()
+    d = tmp_path / ("hf_mixtral" if moe else "hf_llama")
+    model.save_pretrained(str(d), safe_serialization=True)
+    return model, str(d)
+
+
+def convert_and_load(src_dir, tmp_path, name):
+    out = str(tmp_path / f"{name}.m")
+    spec = convert_hf(src_dir, FloatType.F32, out, progress=lambda *a: None)
+    engine = InferenceEngine(out, dtype=jnp.float32)
+    return spec, engine
+
+
+def hf_logits(model, tokens):
+    with torch.no_grad():
+        out = model(torch.tensor([tokens], dtype=torch.long))
+    return out.logits[0].float().numpy()
+
+
+class TestPermute:
+    def test_permute_round_trip_structure(self):
+        # permute moves column pairs: applying it twice with the inverse
+        # pattern isn't identity, but shape and row-set must be preserved
+        w = np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+        p = permute_qk(w, 4)
+        assert p.shape == w.shape
+        assert set(map(tuple, p)) == set(map(tuple, w))
+
+
+class TestHfLlamaParity:
+    def test_logits_match_hf(self, tmp_path):
+        model, src = save_hf_llama(tmp_path)
+        _, engine = convert_and_load(src, tmp_path, "llama")
+        tokens = [1, 17, 42, 5, 88, 3]
+        want = hf_logits(model, tokens)
+        got = engine.forward(tokens)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_stepwise_matches_hf(self, tmp_path):
+        model, src = save_hf_llama(tmp_path)
+        _, engine = convert_and_load(src, tmp_path, "llama2")
+        tokens = [2, 9, 31, 77]
+        want = hf_logits(model, tokens)
+        for i, tok in enumerate(tokens):
+            got = engine.decode_step(tok)
+            np.testing.assert_allclose(got, want[i], rtol=3e-4, atol=3e-4, err_msg=f"pos {i}")
+
+
+class TestHfMixtralParity:
+    def test_logits_match_hf(self, tmp_path):
+        model, src = save_hf_llama(tmp_path, moe=True)
+        spec, engine = convert_and_load(src, tmp_path, "mixtral")
+        assert spec.n_experts == 4 and spec.n_active_experts == 2
+        tokens = [1, 17, 42, 5]
+        want = hf_logits(model, tokens)
+        got = engine.forward(tokens)
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+
+class TestHfTokenizerConverter:
+    def test_bpe_tokenizer_json(self, tmp_path):
+        vocab = {"<unk>": 0, "a": 1, "b": 2, "ab": 3, " ": 4}
+        tok_json = {
+            "model": {"type": "BPE", "vocab": vocab, "merges": ["a b"]},
+            "added_tokens": [
+                {"id": 5, "content": "<s>"},
+                {"id": 6, "content": "</s>"},
+            ],
+        }
+        cfg = {
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<s>",
+            "eos_token": "</s>",
+            "chat_template": "{% for m in messages %}<|im_start|>{% endfor %}",
+        }
+        d = tmp_path / "tok"
+        d.mkdir()
+        (d / "tokenizer.json").write_text(json.dumps(tok_json))
+        (d / "tokenizer_config.json").write_text(json.dumps(cfg))
+        out = str(tmp_path / "t.t")
+        data = convert_hf_tokenizer(str(d), out)
+        assert data.bos_id == 5 and data.eos_id == 6
+        assert data.vocab[3] == b"ab"
+        assert data.chat_template and "<|im_start|>" in data.chat_template
+
+        from distributed_llama_tpu.tokenizer import Tokenizer
+
+        tok = Tokenizer.from_file(out)
+        assert tok.vocab_size == 7
+
+
+class TestLlama3TokenizerConverter:
+    def test_base64_vocab(self, tmp_path):
+        import base64
+
+        from distributed_llama_tpu.converter.tokenizers import (
+            LLAMA3_N_SPECIAL,
+            convert_llama3_tokenizer,
+        )
+
+        lines = []
+        for i, tok in enumerate([b"a", b"b", b"ab", b" "]):
+            lines.append(f"{base64.b64encode(tok).decode()} {i}")
+        path = tmp_path / "tokenizer.model"
+        path.write_text("\n".join(lines))
+        out = str(tmp_path / "l3.t")
+        data = convert_llama3_tokenizer(str(path), out)
+        assert data.vocab[:4] == [b"a", b"b", b"ab", b" "]
+        assert len(data.vocab) == 4 + LLAMA3_N_SPECIAL
+        assert b"<|eot_id|>" in data.vocab
+        assert data.chat_eos_id == 128009
