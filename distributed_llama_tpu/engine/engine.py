@@ -66,7 +66,15 @@ class InferenceEngine:
             model_path, dtype=dtype, max_seq_len=max_seq_len, **cfg_overrides
         )
         self.tp = tp
-        self.cache_dtype = cache_dtype or dtype
+        if dtype == "q40" and tp > 1:
+            raise NotImplementedError(
+                "tensor parallelism over q40 packed weights lands with the "
+                "multi-host work; use dtype=bf16 with --tp for now"
+            )
+        if cache_dtype is None:
+            # "q40" is a weights-only format; the KV cache stays bf16
+            cache_dtype = jnp.bfloat16 if dtype == "q40" else dtype
+        self.cache_dtype = cache_dtype
         if tp > 1:
             from distributed_llama_tpu.parallel import tensor_parallel as tpmod
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,6 +28,9 @@ def _t(x: np.ndarray, dtype) -> np.ndarray:
     return np.ascontiguousarray(x.T).astype(dtype)
 
 
+QUANTIZED_DTYPE = "q40"  # sentinel: keep matmul weights 4-bit on device
+
+
 def load_params(
     reader: ModelFileReader,
     cfg: LlamaConfig | None = None,
@@ -37,25 +41,41 @@ def load_params(
 
     dtype applies to the matmul weights; embeddings and norm scales stay f32
     (they are F32 in the file too — reference: src/transformer.cpp:296-310).
+    ``dtype="q40"`` keeps the attention/FFN/wcls matrices packed 4-bit
+    (QuantizedMatrix leaves, fed to the fused Pallas matmul); MoE expert
+    banks use bf16 until the quantized expert einsum lands.
     """
     spec = reader.spec
     cfg = cfg or config_from_spec(spec)
-    np_dtype = np.dtype(dtype)  # ml_dtypes registers bfloat16 with numpy
+    quantized = dtype == QUANTIZED_DTYPE
+    np_dtype = np.dtype(jnp.bfloat16 if quantized else dtype)
 
     def cast(x: np.ndarray) -> np.ndarray:
         return x.astype(np_dtype)
 
-    layers: dict[str, list[np.ndarray]] = {}
+    def weight(name: str):
+        """A matmul weight in x@W orientation: QuantizedMatrix or numpy."""
+        if quantized:
+            from distributed_llama_tpu.ops.q40 import pack_q40_raw, quantize_q40_tpu
+            from distributed_llama_tpu.quants import FloatType
+
+            e = reader.entries[name]
+            if e.float_type == FloatType.Q40:
+                return pack_q40_raw(reader.raw(name), e.shape)  # exact repack
+            return quantize_q40_tpu(_t(reader.tensor(name), np.float32))
+        return cast(_t(reader.tensor(name), np.float32))
+
+    layers: dict[str, list] = {}
 
     def add(key: str, value) -> None:
         layers.setdefault(key, []).append(value)
 
     for l in range(cfg.n_layers):
         p = f"layers.{l}."
-        add("q", cast(_t(reader.tensor(p + "q"), np.float32)))
-        add("k", cast(_t(reader.tensor(p + "k"), np.float32)))
-        add("v", cast(_t(reader.tensor(p + "v"), np.float32)))
-        add("wo", cast(_t(reader.tensor(p + "wo"), np.float32)))
+        add("q", weight(p + "q"))
+        add("k", weight(p + "k"))
+        add("v", weight(p + "v"))
+        add("wo", weight(p + "wo"))
         add("rms_att", reader.tensor(p + "rms_att").astype(np.float32))
         add("rms_ffn", reader.tensor(p + "rms_ffn").astype(np.float32))
         if cfg.is_moe:
@@ -70,22 +90,33 @@ def load_params(
             add("moe_gate", cast(np.stack(gates)))
             add("moe_down", cast(np.stack(downs)))
         else:
-            add("gate", cast(_t(reader.tensor(p + "gate"), np.float32)))
-            add("down", cast(_t(reader.tensor(p + "down"), np.float32)))
-            add("up", cast(_t(reader.tensor(p + "up"), np.float32)))
+            add("gate", weight(p + "gate"))
+            add("down", weight(p + "down"))
+            add("up", weight(p + "up"))
         if cfg.arch == ArchType.GROK1:
             add("rms_moe", reader.tensor(p + "rms_moe").astype(np.float32))
             add("rms_ffn2", reader.tensor(p + "rms_ffn2").astype(np.float32))
 
-    # stays numpy (ml_dtypes handles bf16): placement happens once, in the
-    # engine, via device_put — plain or with a NamedSharding under TP — so no
-    # full copy ever lands on a single device's HBM first
-    stacked = {k: np.stack(vs) for k, vs in layers.items()}
+    if quantized:
+        # q40 layers stay UNSTACKED (a list of per-layer dicts, consumed by
+        # an unrolled layer loop): stacking + per-layer slicing would make
+        # XLA hoist layout copies of every sliced Pallas operand, doubling
+        # HBM residency of the whole weight set (observed OOM on v5e)
+        n_layers = cfg.n_layers
+        layer_list = [
+            {k: vs[l] for k, vs in layers.items()} for l in range(n_layers)
+        ]
+        layers_out: Any = layer_list
+    else:
+        # stays numpy (ml_dtypes handles bf16): placement happens once, in
+        # the engine, via device_put — plain or with a NamedSharding under
+        # TP — so no full copy ever lands on a single device's HBM first
+        layers_out = {k: np.stack(vs) for k, vs in layers.items()}
     return {
         "embedding": reader.tensor("embedding").astype(np.float32),
-        "layers": stacked,
+        "layers": layers_out,
         "rms_final": reader.tensor("rms_final").astype(np.float32),
-        "wcls": cast(_t(reader.tensor("wcls"), np.float32)),
+        "wcls": weight("wcls"),
         "rope_table": build_rope_table(cfg),
     }
 

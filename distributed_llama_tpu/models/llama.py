@@ -52,11 +52,17 @@ def _activation(x: jax.Array, act: HiddenAct) -> jax.Array:
     return jax.nn.silu(x)
 
 
-def _matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+def _matmul(x: jax.Array, w) -> jax.Array:
     """x [T, n] @ w [n, d] with f32 accumulation on the MXU.
 
+    ``w`` is a plain array (bf16/f32) or a Q40 :class:`QuantizedMatrix`,
+    which routes to the fused Pallas kernel (weights stay 4-bit in HBM).
     precision=HIGHEST keeps f32 operands in true f32 on TPU (parity mode);
     it is a no-op for the production bf16 path."""
+    from distributed_llama_tpu.ops.q40 import QuantizedMatrix, q40_matmul
+
+    if isinstance(w, QuantizedMatrix):
+        return q40_matmul(x, w)
     return jax.lax.dot_general(
         x,
         w,
@@ -187,13 +193,24 @@ def forward_tokens(
     if cfg.arch.name == "GROK1":
         x = x * 78.38367176906169  # input scale (reference: src/grok1-tasks.cpp:11-14)
 
-    def body(carry, scanned):
-        xc = carry
-        lp, cache_l = scanned
-        xc, new_cache_l = block_forward(cfg, xc, lp, cache_l, pos, rope_rows, axis_name)
-        return xc, new_cache_l
+    if isinstance(params["layers"], (list, tuple)):
+        # unrolled layer loop: used by the q40 path, whose Pallas-call
+        # operands must be the resident buffers themselves (scan-slicing a
+        # stacked array makes XLA hoist a full copy of every layer's weights)
+        new_layers = []
+        for l, lp in enumerate(params["layers"]):
+            x, nc = block_forward(cfg, x, lp, cache[l], pos, rope_rows, axis_name)
+            new_layers.append(nc)
+        new_cache = jnp.stack(new_layers)
+    else:
 
-    x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
+        def body(carry, scanned):
+            xc = carry
+            lp, cache_l = scanned
+            xc, new_cache_l = block_forward(cfg, xc, lp, cache_l, pos, rope_rows, axis_name)
+            return xc, new_cache_l
+
+        x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
 
     x = rmsnorm(x, params["rms_final"])
     logits = _matmul(x.astype(params["wcls"].dtype), params["wcls"])

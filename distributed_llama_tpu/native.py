@@ -1,0 +1,143 @@
+"""ctypes bindings for the native host library (native/libdllama_native.so).
+
+The compute path is JAX/XLA/Pallas; this library covers the *host* hot paths
+around it — Q40 repacking/dequantization at weight-load time and BPE encode —
+the same split the reference makes between its engine and its loaders.
+
+Loading is best-effort: if the library isn't built (``make -C native``), every
+caller falls back to the numpy/Python implementation, so the package works
+from a clean checkout; the native path is an optimization, not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_LIB_DIR, "libdllama_native.so")
+
+_lib = None
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _LIB_DIR],
+            capture_output=True, timeout=120, check=True,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load_library(build: bool = True):
+    """Returns the loaded library or None. Builds it on first use if a
+    toolchain is available."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH) and build:
+        if not _try_build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    lib.q40_dequant_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.q40_repack_tpu.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.bpe_new.restype = ctypes.c_void_p
+    lib.bpe_new.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+    ]
+    lib.bpe_free.argtypes = [ctypes.c_void_p]
+    lib.bpe_encode.restype = ctypes.c_int32
+    lib.bpe_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+# ---------------------------------------------------------------------------
+# Q40
+# ---------------------------------------------------------------------------
+
+
+def q40_dequant_f32(blocks: np.ndarray, n_values: int) -> np.ndarray | None:
+    """Dequantize raw Q40 file bytes → f32 [n_values]; None if lib missing."""
+    lib = load_library()
+    if lib is None:
+        return None
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    out = np.empty(n_values, np.float32)
+    lib.q40_dequant_f32(
+        blocks.ctypes.data, n_values // 32, out.ctypes.data
+    )
+    return out
+
+
+def q40_repack_tpu(blocks: np.ndarray, d_out: int, d_in: int):
+    """Repack raw Q40 file bytes to (packed [d_in/2, d_out] uint8,
+    scales [d_in/32, d_out] f32); None if lib missing."""
+    lib = load_library()
+    if lib is None:
+        return None
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    packed = np.zeros((d_in // 2, d_out), np.uint8)  # OR-accumulated
+    scales = np.empty((d_in // 32, d_out), np.float32)
+    lib.q40_repack_tpu(
+        blocks.ctypes.data, d_out, d_in, packed.ctypes.data, scales.ctypes.data
+    )
+    return packed, scales
+
+
+# ---------------------------------------------------------------------------
+# BPE
+# ---------------------------------------------------------------------------
+
+
+class NativeBpe:
+    """Owns a native tokenizer handle; mirrors Tokenizer.encode's core loop."""
+
+    def __init__(self, vocab: list[bytes], scores: list[float]):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        blob = b"".join(vocab)
+        offsets = np.zeros(len(vocab) + 1, np.int64)
+        np.cumsum([len(t) for t in vocab], out=offsets[1:])
+        self._blob = np.frombuffer(blob, np.uint8).copy()
+        scores_arr = np.asarray(scores, np.float32)
+        self._handle = lib.bpe_new(
+            self._blob.ctypes.data,
+            offsets.ctypes.data,
+            scores_arr.ctypes.data,
+            len(vocab),
+        )
+
+    def encode(self, text: bytes) -> list[int]:
+        out = np.empty(len(text) + 1, np.int32)
+        n = self._lib.bpe_encode(self._handle, text, len(text), out.ctypes.data)
+        return out[:n].tolist()
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.bpe_free(self._handle)
+            self._handle = None

@@ -1,0 +1,12 @@
+"""TPU compute kernels (Pallas) and quantized-tensor containers.
+
+The reference's hand-written NEON/AVX2 kernels (src/funcs.cpp) map here:
+matmul over Q40 weights is a Pallas kernel that keeps weights packed in HBM
+and dequantizes in VMEM on the way into the MXU; everything else (rmsnorm,
+softmax, silu/gelu, rope) is left to XLA fusion, which already emits optimal
+VPU code for elementwise chains.
+"""
+
+from distributed_llama_tpu.ops.q40 import QuantizedMatrix, pack_q40_tpu, q40_matmul
+
+__all__ = ["QuantizedMatrix", "pack_q40_tpu", "q40_matmul"]

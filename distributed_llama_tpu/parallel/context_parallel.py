@@ -1,0 +1,136 @@
+"""Sequence/context parallelism: ring attention and sharded-KV decode.
+
+The reference has NO long-context strategy — every node holds the full
+sequence in its KV slice and attention is quadratic on one node
+(SURVEY.md §5: "No ring attention / blockwise / Ulysses / CP anywhere"); its
+only levers are --max-seq-len and a disc-backed KV cache. Here sequence
+parallelism is first-class:
+
+* :func:`ring_attention` — causal blockwise attention for prefill with the
+  sequence sharded over an ``sp`` mesh axis. KV chunks rotate around the
+  ring with ``jax.lax.ppermute`` while each device accumulates its query
+  chunk's output with an online (flash-style) softmax — compute overlaps the
+  ICI transfer, and no device ever materializes the full sequence.
+* :func:`sp_decode_attention` — single-token decode against a
+  sequence-sharded KV cache: each device attends over its local cache slice,
+  then the partial (max, denominator, numerator) triples merge across the
+  ring with one pmax + two psums.
+
+Both run inside ``shard_map`` and are validated against full attention on a
+virtual CPU mesh (tests/test_context_parallel.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_attention(
+    q: jax.Array,  # [Tq, K, M, hd] f32 (grouped: K kv-heads × M q-per-kv)
+    k: jax.Array,  # [Tk, K, hd]
+    v: jax.Array,  # [Tk, K, hd]
+    q_positions: jax.Array,  # [Tq] global positions
+    k_positions: jax.Array,  # [Tk]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Masked scores of one (q-chunk, kv-chunk) pair → (m, l, o) partials.
+
+    m: running max [Tq, K, M]; l: exp-sum [Tq, K, M]; o: weighted V sum
+    [Tq, K, M, hd]. Entirely local — no collectives.
+    """
+    hd = q.shape[-1]
+    scores = jnp.einsum("tkmh,skh->tkms", q, k) / jnp.sqrt(jnp.float32(hd))
+    mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)  # [Tq, K, M]
+    # fully-masked rows (no kv visible in this chunk) produce m=-inf; guard
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("tkms,skh->tkmh", p, v)
+    return safe_m, l, o
+
+
+def _merge(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partials (standard flash-attention merge)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    return m, l, o
+
+
+def ring_attention(
+    q: jax.Array,  # [Tq, H, hd] local query chunk
+    k: jax.Array,  # [Tk, K, hd] local key chunk
+    v: jax.Array,  # [Tk, K, hd] local value chunk
+    axis_name: str,
+    chunk_offset: jax.Array | None = None,
+) -> jax.Array:
+    """Causal blockwise attention with the sequence sharded over
+    ``axis_name``. Device i holds positions [i*Tq, (i+1)*Tq). Returns the
+    local output chunk [Tq, H, hd] (f32).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    Tq = q.shape[0]
+    Tk = k.shape[0]
+    H = q.shape[1]
+    K = k.shape[1]
+    kv_mul = H // K
+
+    qg = q.reshape(Tq, K, kv_mul, q.shape[-1]).astype(jnp.float32)
+    base = idx * Tq if chunk_offset is None else chunk_offset
+    q_pos = base + jnp.arange(Tq)
+
+    def step(s, carry):
+        kc, vc, m, l, o = carry
+        src_chunk = (idx - s) % n  # whose kv chunk we currently hold
+        k_pos = src_chunk * Tk + jnp.arange(Tk)
+        ms, ls, os_ = _chunk_attention(qg, kc.astype(jnp.float32), vc.astype(jnp.float32), q_pos, k_pos)
+        m, l, o = _merge(m, l, o, ms, ls, os_)
+        # rotate kv around the ring: device i sends to i+1 (so chunks walk
+        # backwards relative to each device's view)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return kc, vc, m, l, o
+
+    m0 = jnp.full((Tq, K, kv_mul), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((Tq, K, kv_mul), jnp.float32)
+    o0 = jnp.zeros((Tq, K, kv_mul, q.shape[-1]), jnp.float32)
+    _, _, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, o0))
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(Tq, H, q.shape[-1])
+
+
+def sp_decode_attention(
+    q: jax.Array,  # [H, hd] the single decode query (replicated)
+    k_local: jax.Array,  # [Sl, K, hd] local KV-cache slice (sequence-sharded)
+    v_local: jax.Array,  # [Sl, K, hd]
+    pos: jax.Array,  # scalar: current absolute position (attend s <= pos)
+    axis_name: str,
+) -> jax.Array:
+    """One-token attention over a sequence-sharded KV cache. Every device
+    computes partials over its slice; one pmax + two psums merge them.
+    Returns [H, hd] (replicated)."""
+    idx = jax.lax.axis_index(axis_name)
+    Sl, K, hd = k_local.shape
+    H = q.shape[0]
+    kv_mul = H // K
+    qg = q.reshape(1, K, kv_mul, hd).astype(jnp.float32)
+    positions = idx * Sl + jnp.arange(Sl)
+    q_pos = jnp.asarray([pos])
+    m, l, o = _chunk_attention(
+        qg, k_local.astype(jnp.float32), v_local.astype(jnp.float32), q_pos, positions
+    )
+    # cross-device online-softmax merge
+    g_m = jax.lax.pmax(m, axis_name)
+    scale = jnp.exp(m - g_m)
+    g_l = jax.lax.psum(l * scale, axis_name)
+    g_o = jax.lax.psum(o * scale[..., None], axis_name)
+    out = g_o / jnp.maximum(g_l, 1e-30)[..., None]
+    return out.reshape(H, hd)

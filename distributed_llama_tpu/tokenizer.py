@@ -48,6 +48,16 @@ class Tokenizer:
         self._index: dict[bytes, int] = {}
         for i, tok in enumerate(self.vocab):
             self._index.setdefault(tok, i)
+        # the O(n^2) split+merge core runs natively when the host lib is
+        # built (same algorithm, see native/bpe_native.cpp)
+        self._native = None
+        try:
+            from distributed_llama_tpu import native
+
+            if native.available():
+                self._native = native.NativeBpe(self.vocab, self.scores)
+        except Exception:
+            self._native = None
 
     @classmethod
     def from_file(cls, path: str, model_vocab_size: int | None = None) -> "Tokenizer":
@@ -68,6 +78,15 @@ class Tokenizer:
         tokens: list[int] = []
         if add_bos:
             tokens.append(self.bos_id)
+
+        if self._native is not None:
+            # the dummy-prefix space token participates in merging exactly as
+            # if the text began with a literal space (it is the " " piece)
+            prefixed = (b" " if text and b" " in self._index else b"") + text
+            tokens.extend(self._native.encode(prefixed))
+            if add_eos:
+                tokens.append(self.eos_id)
+            return tokens
 
         # dummy prefix space (sentencepiece add_dummy_prefix;
         # reference: src/tokenizer.cpp:198-207)

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Minimal client for the OpenAI-compatible API server (the reference ships a
+node.js equivalent, examples/chat-api-client.js). Streams a chat completion.
+
+Usage: python chat-api-client.py [host:port]
+"""
+
+import json
+import sys
+import urllib.request
+
+base = f"http://{sys.argv[1] if len(sys.argv) > 1 else 'localhost:9990'}"
+
+body = {
+    "messages": [
+        {"role": "system", "content": "You are a helpful assistant."},
+        {"role": "user", "content": "Say hello!"},
+    ],
+    "temperature": 0.7,
+    "max_tokens": 64,
+    "stream": True,
+}
+
+req = urllib.request.Request(
+    base + "/v1/chat/completions",
+    data=json.dumps(body).encode(),
+    headers={"Content-Type": "application/json"},
+)
+with urllib.request.urlopen(req) as r:
+    buffer = b""
+    while True:
+        chunk = r.read(1)
+        if not chunk:
+            break
+        buffer += chunk
+        while b"\r\n\r\n" in buffer:
+            event, buffer = buffer.split(b"\r\n\r\n", 1)
+            if not event.startswith(b"data: "):
+                continue
+            data = event[len(b"data: "):].decode()
+            if data == "[DONE]":
+                print()
+                sys.exit(0)
+            delta = json.loads(data)["choices"][0].get("delta", {})
+            sys.stdout.write(delta.get("content", ""))
+            sys.stdout.flush()

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Long-prompt determinism smoke test: fill the whole KV cache with a long
+# prompt and greedy-decode to the context limit (the reference's macbeth.sh,
+# examples/macbeth.sh:1-7, does the same against its CPU engine).
+#
+# Usage: ./kv-cache-fill.sh <model.m> <tokenizer.t> [max_seq_len]
+
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${1:?model.m path required}"
+TOKENIZER="${2:?tokenizer.t path required}"
+MAXSEQ="${3:-2048}"
+
+PROMPT="Duncan. What bloody man is that? He can report, as seemeth by his \
+plight, of the revolt the newest state. Malcolm. This is the sergeant who \
+like a good and hardy soldier fought gainst my captivity. Hail, brave friend! \
+Say to the king the knowledge of the broil as thou didst leave it."
+
+python -m distributed_llama_tpu.apps.cli inference \
+  --model "$MODEL" --tokenizer "$TOKENIZER" \
+  --prompt "$PROMPT" --steps "$MAXSEQ" --max-seq-len "$MAXSEQ" \
+  --temperature 0 --seed 12345

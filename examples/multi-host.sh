@@ -1,0 +1,23 @@
+#!/bin/bash
+# Multi-host launch example: every host runs the SAME program with the same
+# flags; only --host-id differs. This replaces the reference's asymmetric
+# root/worker split (examples/n-workers.sh spawns `dllama worker` processes
+# and one root that streams weights to them; here each host reads its own
+# shards of the .m file and jax.distributed forms the collective mesh).
+#
+# On host i of N (host 0 doubles as the coordinator):
+#   ./multi-host.sh <model.m> <tokenizer.t> <coordinator-host:port> <N> <i>
+
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${1:?model.m}"
+TOKENIZER="${2:?tokenizer.t}"
+COORD="${3:?coordinator host:port}"
+NUM_HOSTS="${4:?num hosts}"
+HOST_ID="${5:?host id}"
+
+exec python -m distributed_llama_tpu.apps.cli worker \
+  --model "$MODEL" --tokenizer "$TOKENIZER" \
+  --coordinator "$COORD" --num-hosts "$NUM_HOSTS" --host-id "$HOST_ID" \
+  --prompt "Hello world" --steps 64 --temperature 0 --seed 1

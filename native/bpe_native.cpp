@@ -1,0 +1,95 @@
+// Native BPE encoder: the host-side tokenize hot path.
+//
+// Same algorithm as the Python Tokenizer.encode (and the reference's
+// src/tokenizer.cpp:170-292): UTF-8 codepoint split with byte fallback (+3),
+// then greedy highest-score adjacent-pair merging. The merge loop is
+// O(n^2 * lookup); C++ with an open-addressing string map makes multi-KB
+// prompts tokenize in microseconds instead of milliseconds.
+//
+// C ABI for ctypes. A tokenizer handle owns copies of the vocab.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Bpe {
+    std::vector<std::string> vocab;
+    std::vector<float> scores;
+    std::unordered_map<std::string, int32_t> index;  // first-wins
+};
+
+}  // namespace
+
+extern "C" {
+
+// vocab_bytes: concatenated token byte strings; offsets: n+1 prefix offsets.
+void* bpe_new(const uint8_t* vocab_bytes, const int64_t* offsets,
+              const float* scores, int32_t n) {
+    Bpe* b = new Bpe();
+    b->vocab.reserve(n);
+    b->scores.assign(scores, scores + n);
+    for (int32_t i = 0; i < n; i++) {
+        b->vocab.emplace_back((const char*)vocab_bytes + offsets[i],
+                              (size_t)(offsets[i + 1] - offsets[i]));
+        b->index.emplace(b->vocab.back(), i);
+    }
+    return b;
+}
+
+void bpe_free(void* handle) { delete (Bpe*)handle; }
+
+// Encode text to token ids. Returns the token count (<= max_out guaranteed
+// by the caller sizing out as len(text) + 1). No BOS/EOS/dummy-prefix —
+// the Python wrapper adds those (they are cheap and policy-laden).
+int32_t bpe_encode(void* handle, const uint8_t* text, int64_t len,
+                   int32_t* out) {
+    Bpe* b = (Bpe*)handle;
+    std::vector<int32_t> tokens;
+    tokens.reserve(len);
+
+    // UTF-8 codepoint split with byte fallback (+3)
+    int64_t i = 0;
+    std::string piece;
+    while (i < len) {
+        int64_t j = i + 1;
+        while (j < len && (text[j] & 0xC0) == 0x80 && (j - i) < 4) j++;
+        piece.assign((const char*)text + i, (size_t)(j - i));
+        auto it = b->index.find(piece);
+        if (it != b->index.end()) {
+            tokens.push_back(it->second);
+        } else {
+            for (int64_t k = i; k < j; k++) tokens.push_back((int32_t)text[k] + 3);
+        }
+        i = j;
+    }
+
+    // greedy best-score adjacent merge
+    std::string merged;
+    while (true) {
+        float best_score = -1e10f;
+        int32_t best_id = -1;
+        int64_t best_idx = -1;
+        for (int64_t k = 0; k + 1 < (int64_t)tokens.size(); k++) {
+            merged = b->vocab[tokens[k]];
+            merged += b->vocab[tokens[k + 1]];
+            auto it = b->index.find(merged);
+            if (it != b->index.end() && b->scores[it->second] > best_score) {
+                best_score = b->scores[it->second];
+                best_id = it->second;
+                best_idx = k;
+            }
+        }
+        if (best_idx < 0) break;
+        tokens[best_idx] = best_id;
+        tokens.erase(tokens.begin() + best_idx + 1);
+    }
+
+    std::memcpy(out, tokens.data(), tokens.size() * sizeof(int32_t));
+    return (int32_t)tokens.size();
+}
+
+}  // extern "C"

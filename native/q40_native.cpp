@@ -1,0 +1,109 @@
+// Native host-side kernels for the weight-loading path.
+//
+// The reference does all of this in C++ too (quants.cpp dequant at load,
+// commands.cpp splitWeights at scatter); here the hot host paths are the
+// Q40 file-block -> TPU-layout repack and bulk dequantization, which for a
+// 405B/238GB checkpoint are the difference between minutes and hours on the
+// loading host. Exposed as a C ABI for ctypes (no pybind11 dependency).
+//
+// Layouts:
+//   file blocks (reference src/quants.hpp:17-20): per 32 values,
+//     2-byte f16 scale + 16 bytes, low nibble = value j, high = value j+16.
+//   TPU packed (ops/q40.py pack_q40_tpu): for W stored row-major
+//     [d_out, d_in], outputs packed[d_in/2, d_out] with original column pairs
+//     (2i, 2i+1) of W^T in (low, high) nibbles, and scales_t[d_in/32, d_out].
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// f16 -> f32 without F16C intrinsics (bit manipulation, handles subnormals)
+inline float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t mant = h & 0x3FF;
+    uint32_t bits;
+    if (exp == 0) {
+        if (mant == 0) {
+            bits = sign;
+        } else {
+            // subnormal: normalize
+            int shift = 0;
+            while (!(mant & 0x400)) { mant <<= 1; shift++; }
+            mant &= 0x3FF;
+            bits = sign | ((112 - shift) << 23) | (mant << 13);
+        }
+    } else if (exp == 0x1F) {
+        bits = sign | 0x7F800000 | (mant << 13);
+    } else {
+        bits = sign | ((exp + 112) << 23) | (mant << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+constexpr int QK = 32;
+constexpr int BLOCK_BYTES = 2 + QK / 2;  // f16 scale + 16 nibble bytes
+
+}  // namespace
+
+extern "C" {
+
+// Dequantize n_blocks Q40 file blocks to f32 (row-major stream).
+// out must hold n_blocks * 32 floats.
+void q40_dequant_f32(const uint8_t* blocks, int64_t n_blocks, float* out) {
+    for (int64_t b = 0; b < n_blocks; b++) {
+        const uint8_t* blk = blocks + b * BLOCK_BYTES;
+        uint16_t h;
+        std::memcpy(&h, blk, 2);
+        const float scale = f16_to_f32(h);
+        const uint8_t* qs = blk + 2;
+        float* o = out + b * QK;
+        for (int j = 0; j < QK / 2; j++) {
+            o[j] = (float)((int)(qs[j] & 0xF) - 8) * scale;
+            o[j + QK / 2] = (float)((int)(qs[j] >> 4) - 8) * scale;
+        }
+    }
+}
+
+// Repack a Q40 tensor from file block order into the TPU layout.
+//   blocks:   [d_out * (d_in/32)] file blocks, row-major per output row
+//   packed:   out uint8 [d_in/2, d_out] — MUST be zero-initialized (nibbles
+//             are OR-ed in; each byte receives exactly two writes)
+//   scales_t: out f32 [d_in/32, d_out]
+// Tiled over d_out to keep the transposed writes in cache.
+void q40_repack_tpu(const uint8_t* blocks, int64_t d_out, int64_t d_in,
+                    uint8_t* packed, float* scales_t) {
+    const int64_t bpr = d_in / QK;  // blocks per row
+    const int64_t TILE = 64;
+    for (int64_t r0 = 0; r0 < d_out; r0 += TILE) {
+        const int64_t r1 = r0 + TILE < d_out ? r0 + TILE : d_out;
+        for (int64_t r = r0; r < r1; r++) {
+            const uint8_t* row = blocks + r * bpr * BLOCK_BYTES;
+            for (int64_t b = 0; b < bpr; b++) {
+                const uint8_t* blk = row + b * BLOCK_BYTES;
+                uint16_t h;
+                std::memcpy(&h, blk, 2);
+                scales_t[b * d_out + r] = f16_to_f32(h);
+                const uint8_t* qs = blk + 2;
+                // value index v within the row: v = b*32 + j (low nibble)
+                // or b*32 + 16 + j (high nibble). Output byte at
+                // packed[v/2 * d_out + r], low nibble if v even.
+                for (int j = 0; j < QK / 2; j++) {
+                    const int v_lo = (int)(b * QK) + j;
+                    const int v_hi = v_lo + QK / 2;
+                    const uint8_t lo_val = qs[j] & 0xF;
+                    const uint8_t hi_val = qs[j] >> 4;
+                    uint8_t* p_lo = packed + (int64_t)(v_lo >> 1) * d_out + r;
+                    uint8_t* p_hi = packed + (int64_t)(v_hi >> 1) * d_out + r;
+                    *p_lo |= (v_lo & 1) ? (uint8_t)(lo_val << 4) : lo_val;
+                    *p_hi |= (v_hi & 1) ? (uint8_t)(hi_val << 4) : hi_val;
+                }
+            }
+        }
+    }
+}
+
+}  // extern "C"

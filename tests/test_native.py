@@ -1,0 +1,65 @@
+"""Native C++ library tests: results must match the numpy/Python paths
+bit-for-bit. Skipped when no toolchain is available to build the library."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu import native
+from distributed_llama_tpu.quants import (
+    dequantize_q40,
+    q40_from_bytes,
+    q40_to_bytes,
+    quantize_q40,
+)
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native lib not built")
+
+
+class TestQ40Native:
+    def test_dequant_matches_python(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4096).astype(np.float32)
+        raw = np.frombuffer(q40_to_bytes(*quantize_q40(x)), np.uint8)
+        want = dequantize_q40(*q40_from_bytes(raw, 4096))
+        got = native.q40_dequant_f32(raw, 4096)
+        np.testing.assert_array_equal(got, want)
+
+    def test_repack_matches_python(self):
+        from distributed_llama_tpu.ops.q40 import QuantizedMatrix, pack_q40_tpu
+
+        rng = np.random.RandomState(1)
+        d_out, d_in = 64, 128
+        w = rng.randn(d_out, d_in).astype(np.float32)
+        qs, scales = quantize_q40(w)
+        raw = np.frombuffer(q40_to_bytes(qs, scales), np.uint8)
+
+        got = native.q40_repack_tpu(raw, d_out, d_in)
+        assert got is not None
+        packed_n, scales_n = got
+
+        # python reference path (bypass the native fast path inside
+        # pack_q40_tpu by computing it manually)
+        lo = qs.reshape(d_out, -1, 16) & 0xF
+        hi = qs.reshape(d_out, -1, 16) >> 4
+        vals = np.concatenate([lo, hi], axis=-1).reshape(d_out, d_in).T
+        want_packed = (vals[0::2] | (vals[1::2] << 4)).astype(np.uint8)
+        want_scales = scales.reshape(d_out, -1).astype(np.float32).T
+
+        np.testing.assert_array_equal(packed_n, want_packed)
+        np.testing.assert_allclose(scales_n, want_scales)
+
+
+class TestBpeNative:
+    def test_encode_matches_python(self):
+        from distributed_llama_tpu.tokenizer import Tokenizer
+
+        from tests.test_tokenizer import make_sentencepiece_like_tokenizer
+
+        tok = make_sentencepiece_like_tokenizer()
+        assert tok._native is not None, "native BPE should have loaded"
+        python_tok = Tokenizer(tok.data)
+        python_tok._native = None  # force python path
+
+        for text in ["hello world", "hello", "", "é", "abc hello", " leading", "a\x01b"]:
+            assert tok.encode(text, add_bos=True) == python_tok.encode(text, add_bos=True), text
+            assert tok.encode(text, add_eos=True) == python_tok.encode(text, add_eos=True), text
