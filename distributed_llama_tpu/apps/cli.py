@@ -230,14 +230,9 @@ def worker(args) -> None:
 
 
 def main(argv=None) -> None:
-    import os
+    from distributed_llama_tpu.platform import reassert_jax_platforms
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # some environments pin jax_platforms in sitecustomize, which beats
-        # the env var; re-assert the user's explicit choice
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    reassert_jax_platforms()
     args = build_parser().parse_args(argv)
     if args.mode == "inference":
         generate(args, benchmark=True)

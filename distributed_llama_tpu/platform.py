@@ -1,0 +1,38 @@
+"""JAX platform selection helper.
+
+The container's sitecustomize may register a TPU plugin and pin
+``jax_platforms`` before user code runs, which silently beats the
+``JAX_PLATFORMS`` env var. Every entry point that honors the env var
+(CLI, API server, driver entry) calls :func:`reassert_jax_platforms`
+right after importing jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def reassert_jax_platforms() -> None:
+    """Re-apply ``JAX_PLATFORMS`` from the environment over any pinned
+    jax_platforms config (must run before first device initialization)."""
+    env = os.environ.get("JAX_PLATFORMS")
+    if env:
+        import jax
+
+        jax.config.update("jax_platforms", env)
+
+
+def virtual_cpu_mesh_env(n_devices: int) -> dict[str, str]:
+    """Environment for a child process running on an ``n_devices``-way
+    virtual CPU mesh — the no-hardware test substrate for multi-chip code
+    (same recipe as tests/conftest.py, forced rather than append-if-absent)."""
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

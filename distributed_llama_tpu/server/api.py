@@ -284,14 +284,10 @@ def serve(args) -> None:
 
 
 def main(argv=None) -> None:
-    import os
-
     from distributed_llama_tpu.apps.cli import build_parser
+    from distributed_llama_tpu.platform import reassert_jax_platforms
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    reassert_jax_platforms()
     parser = build_parser()
     parser.add_argument("--port", type=int, default=9990)
     # mode is meaningless here but the shared parser requires it
