@@ -95,9 +95,10 @@ def random_q40_params_on_device(cfg):
     )
     layers = [
         {
-            "q": qmat(D, H * hd), "k": qmat(D, K * hd), "v": qmat(D, K * hd),
+            "qkv": qmat(D, (H + 2 * K) * hd),  # fused q|k|v (production layout)
             "wo": qmat(H * hd, D),
-            "gate": qmat(D, F), "down": qmat(F, D), "up": qmat(D, F),
+            "gate_up": qmat(D, 2 * F),  # fused gate|up
+            "down": qmat(F, D),
             "rms_att": jnp.ones(D, jnp.float32), "rms_ffn": jnp.ones(D, jnp.float32),
         }
         for _ in range(cfg.n_layers)

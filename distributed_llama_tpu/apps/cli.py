@@ -49,7 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel shards (chips)")
     p.add_argument(
-        "--dtype", choices=["bf16", "f32"], default="bf16", help="on-device weight dtype"
+        "--dtype",
+        choices=["bf16", "f32", "q40"],
+        default="bf16",
+        help="on-device weight dtype (q40 = packed 4-bit via the fused Pallas kernel)",
     )
     p.add_argument("--chat-template", default=None,
                    choices=[None, "llama2", "llama3", "zephyr", "chatml"])
@@ -69,8 +72,17 @@ def make_engine(args):
     import jax.numpy as jnp
 
     from distributed_llama_tpu.engine import InferenceEngine
+    from distributed_llama_tpu.engine.weights import QUANTIZED_DTYPE
 
-    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    if getattr(args, "kv_cache_storage", None) not in (None, "ram"):
+        # the reference spills the KV cache to disc-backed mmap buffers
+        # (reference: src/utils.cpp:50-67); on TPU the cache lives in HBM
+        # inside a jitted program and cannot be file-backed
+        raise SystemExit(
+            f"--kv-cache-storage {args.kv_cache_storage} is not supported on "
+            "TPU (the KV cache is device HBM); use --max-seq-len to bound it"
+        )
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32, "q40": QUANTIZED_DTYPE}[args.dtype]
     engine = InferenceEngine(
         args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp
     )
@@ -141,7 +153,7 @@ def generate(args, benchmark: bool) -> None:
 
     avg = engine.avg_stats()
     total_ms = (time.perf_counter() - total_start) * 1000.0
-    n = max(1, len(engine.stats))
+    n = max(1, engine.total_tokens())
     _print("\n")
     _print(f"Generated tokens:    {generated}\n")
     _print(f"Avg tokens / second: {1000.0 * n / max(total_ms, 1e-9):.2f}\n")
@@ -195,6 +207,12 @@ def chat(args) -> None:
                 break
             logits = engine.decode_step(token)
             prev = token
+        else:
+            # context-limit exit: flush text held back as a possible
+            # stop-string prefix so the reply tail is not lost
+            tail = detector.flush_delta()
+            if tail:
+                _print(tail.decode("utf-8", errors="replace"))
     _print("\n(end of context)\n")
 
 

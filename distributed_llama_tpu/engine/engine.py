@@ -38,12 +38,15 @@ def _prefill_bucket(n: int) -> int:
 
 @dataclasses.dataclass
 class TokenStats:
-    """Per-token timing mirroring the reference's G/I/T printout
-    (reference: src/apps/dllama/dllama.cpp:49-50, 88-93)."""
+    """Per-step timing mirroring the reference's G/I/T printout
+    (reference: src/apps/dllama/dllama.cpp:49-50, 88-93). A batched prefill
+    is one entry covering ``n_tokens`` positions; decode steps have
+    ``n_tokens == 1``."""
 
     generation_ms: float
     inference_ms: float
     transfer_ms: float
+    n_tokens: int = 1
 
 
 class InferenceEngine:
@@ -62,23 +65,25 @@ class InferenceEngine:
         tp: int = 1,
         **cfg_overrides,
     ):
+        quantized = dtype == "q40"
         self.spec, self.cfg, host_params = weights_lib.load_model(
-            model_path, dtype=dtype, max_seq_len=max_seq_len, **cfg_overrides
+            model_path,
+            dtype=dtype,
+            max_seq_len=max_seq_len,
+            tp=tp if quantized else 1,
+            **cfg_overrides,
         )
         self.tp = tp
-        if dtype == "q40" and tp > 1:
-            raise NotImplementedError(
-                "tensor parallelism over q40 packed weights lands with the "
-                "multi-host work; use dtype=bf16 with --tp for now"
-            )
         if cache_dtype is None:
             # "q40" is a weights-only format; the KV cache stays bf16
-            cache_dtype = jnp.bfloat16 if dtype == "q40" else dtype
+            cache_dtype = jnp.bfloat16 if quantized else dtype
         self.cache_dtype = cache_dtype
         if tp > 1:
             from distributed_llama_tpu.parallel import tensor_parallel as tpmod
 
-            self._tp_engine = tpmod.TensorParallelForward(self.cfg, tp)
+            self._tp_engine = tpmod.TensorParallelForward(
+                self.cfg, tp, quantized=quantized
+            )
             self.params = self._tp_engine.shard_params(host_params)
             self.cache = self._tp_engine.init_cache(self.cache_dtype)
             self._forward = self._tp_engine.forward
@@ -132,7 +137,7 @@ class InferenceEngine:
         )
         logits = np.asarray(logits[:n])
         elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(TokenStats(elapsed, elapsed, 0.0))
+        self.stats.append(TokenStats(elapsed, elapsed, 0.0, n_tokens=n))
         self.pos += n
         return logits
 
@@ -153,31 +158,38 @@ class InferenceEngine:
         seed: int = 0,
     ) -> np.ndarray:
         """Generate n_steps tokens in ONE device program (no per-token host
-        round trip). Returns int32 [n_steps]. Falls back to the stepwise path
-        under TP (the sharded decode loop lands with the multi-host work)."""
+        round trip). Returns int32 [n_steps]. Under TP the loop is
+        shard_map'd over the mesh with collectives riding every step."""
         if self.pos + n_steps > self.cfg.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {n_steps}")
         import jax
 
         from distributed_llama_tpu.models import sampling
 
-        if self._tp_engine is not None:
-            raise NotImplementedError(
-                "on-device decode loop under TP lands with the multi-host work; "
-                "use decode_step"
-            )
         start = time.perf_counter()
-        tokens, self.cache = sampling.decode_loop(
-            self.cfg,
-            self.params,
-            jnp.int32(first_token),
-            self.cache,
-            jnp.int32(self.pos),
-            n_steps,
-            float(temperature),
-            float(topp),
-            jax.random.PRNGKey(seed),
-        )
+        if self._tp_engine is not None:
+            tokens, self.cache = self._tp_engine.decode_loop(
+                self.params,
+                jnp.int32(first_token),
+                self.cache,
+                jnp.int32(self.pos),
+                n_steps,
+                float(temperature),
+                float(topp),
+                jax.random.PRNGKey(seed),
+            )
+        else:
+            tokens, self.cache = sampling.decode_loop(
+                self.cfg,
+                self.params,
+                jnp.int32(first_token),
+                self.cache,
+                jnp.int32(self.pos),
+                n_steps,
+                float(temperature),
+                float(topp),
+                jax.random.PRNGKey(seed),
+            )
         tokens = np.asarray(tokens)
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         per_token = elapsed_ms / n_steps
@@ -190,11 +202,17 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def avg_stats(self) -> TokenStats:
+        """Per-token averages, weighting batched-prefill entries by their
+        token count (the reference accounts per position, dllama.cpp:88-93)."""
         if not self.stats:
             return TokenStats(0.0, 0.0, 0.0)
-        n = len(self.stats)
+        n = sum(s.n_tokens for s in self.stats)
         return TokenStats(
             sum(s.generation_ms for s in self.stats) / n,
             sum(s.inference_ms for s in self.stats) / n,
             sum(s.transfer_ms for s in self.stats) / n,
+            n_tokens=n,
         )
+
+    def total_tokens(self) -> int:
+        return sum(s.n_tokens for s in self.stats)

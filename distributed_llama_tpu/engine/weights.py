@@ -35,7 +35,7 @@ def load_params(
     reader: ModelFileReader,
     cfg: LlamaConfig | None = None,
     dtype=jnp.bfloat16,
-    rows: tuple[int, int] | None = None,
+    tp: int = 1,
 ) -> Params:
     """Build the host-side params pytree (numpy, not yet on device).
 
@@ -44,10 +44,24 @@ def load_params(
     ``dtype="q40"`` keeps the attention/FFN/wcls matrices packed 4-bit
     (QuantizedMatrix leaves, fed to the fused Pallas matmul); MoE expert
     banks use bf16 until the quantized expert einsum lands.
+
+    ``tp > 1`` (q40 only) builds every quantized matrix as per-shard packs in
+    sharded layout: each shard's slice is READ from the file independently
+    (raw_rows / raw_row_blocks — the read-time equivalent of the reference's
+    RowMatmulSlice/ColMatmulSlice scatter, src/commands.cpp:11-108 +
+    src/transformer.cpp:432-451), packed, and concatenated so a NamedSharding
+    device_put lands each pack on its device unchanged.
     """
     spec = reader.spec
     cfg = cfg or config_from_spec(spec)
     quantized = dtype == QUANTIZED_DTYPE
+    if tp > 1 and not quantized:
+        raise ValueError("load_params(tp>1) is the q40 sharded-pack path; "
+                         "bf16/f32 weights shard via device_put in the engine")
+    if tp > 1:
+        from distributed_llama_tpu.parallel.tensor_parallel import validate_tp
+
+        validate_tp(cfg, tp, quantized=True)
     np_dtype = np.dtype(jnp.bfloat16 if quantized else dtype)
 
     def cast(x: np.ndarray) -> np.ndarray:
@@ -65,6 +79,64 @@ def load_params(
             return quantize_q40_tpu(_t(reader.tensor(name), np.float32))
         return cast(_t(reader.tensor(name), np.float32))
 
+    def weight_fused(names: list[str]):
+        """Several matrices sharing an input dim, packed as ONE matmul with
+        their output dims concatenated (q|k|v, gate|up). Merging the small
+        per-token matvecs into one big one keeps the Q40 kernel in its
+        bandwidth-efficient regime. The file stores [d_out, d_in] row-major
+        blocks, so the Q40-exact concat is a plain byte concat."""
+        from distributed_llama_tpu.ops.q40 import pack_q40_raw, quantize_q40_tpu
+        from distributed_llama_tpu.quants import FloatType
+
+        entries = [reader.entries[n] for n in names]
+        if all(e.float_type == FloatType.Q40 for e in entries):
+            raw = np.concatenate([reader.raw(n) for n in names])
+            d_out = sum(e.shape[0] for e in entries)
+            return pack_q40_raw(raw, (d_out, entries[0].shape[1]))
+        mats = [_t(reader.tensor(n), np.float32) for n in names]
+        return quantize_q40_tpu(np.concatenate(mats, axis=1))
+
+    def shard_out(names: list[str], s: int):
+        """Output-dim shard s of (fused) matrices: each source contributes
+        rows [s*d/tp, (s+1)*d/tp) (RowMatmulSlice, src/commands.cpp:11-43)."""
+        from distributed_llama_tpu.ops.q40 import pack_q40_raw, quantize_q40_tpu
+        from distributed_llama_tpu.quants import FloatType
+
+        entries = [reader.entries[n] for n in names]
+        if all(e.float_type == FloatType.Q40 for e in entries):
+            raws, d_out = [], 0
+            for nm, e in zip(names, entries):
+                lo, hi = e.shape[0] * s // tp, e.shape[0] * (s + 1) // tp
+                raws.append(reader.raw_rows(nm, lo, hi))
+                d_out += hi - lo
+            return pack_q40_raw(np.concatenate(raws), (d_out, entries[0].shape[1]))
+        mats = []
+        for nm, e in zip(names, entries):
+            lo, hi = e.shape[0] * s // tp, e.shape[0] * (s + 1) // tp
+            mats.append(np.ascontiguousarray(reader.tensor_rows(nm, lo, hi).T))
+        return quantize_q40_tpu(np.concatenate(mats, axis=1).astype(np.float32))
+
+    def shard_in(name: str, s: int):
+        """Input-dim shard s: quant-block-aligned column range of every row
+        (ColMatmulSlice, src/commands.cpp:45-73)."""
+        from distributed_llama_tpu.ops.q40 import pack_q40_raw, quantize_q40_tpu
+        from distributed_llama_tpu.quants import FloatType
+
+        e = reader.entries[name]
+        d_out, d_in = e.shape
+        lo, hi = d_in * s // tp, d_in * (s + 1) // tp
+        if e.float_type == FloatType.Q40:
+            sl = reader.raw_row_blocks(name, lo, hi)
+            return pack_q40_raw(sl.reshape(-1), (d_out, hi - lo))
+        w = _t(reader.tensor(name), np.float32)[lo:hi]
+        return quantize_q40_tpu(np.ascontiguousarray(w))
+
+    def sharded(builder, *args):
+        from distributed_llama_tpu.ops.q40 import concat_shard_packs
+
+        axis = "out" if builder is shard_out else "in"
+        return concat_shard_packs([builder(*args, s) for s in range(tp)], axis)
+
     layers: dict[str, list] = {}
 
     def add(key: str, value) -> None:
@@ -72,10 +144,17 @@ def load_params(
 
     for l in range(cfg.n_layers):
         p = f"layers.{l}."
-        add("q", weight(p + "q"))
-        add("k", weight(p + "k"))
-        add("v", weight(p + "v"))
-        add("wo", weight(p + "wo"))
+        if quantized and tp > 1:
+            add("qkv", sharded(shard_out, [p + "q", p + "k", p + "v"]))
+            add("wo", sharded(shard_in, p + "wo"))
+        elif quantized:
+            add("qkv", weight_fused([p + "q", p + "k", p + "v"]))
+            add("wo", weight(p + "wo"))
+        else:
+            add("q", weight(p + "q"))
+            add("k", weight(p + "k"))
+            add("v", weight(p + "v"))
+            add("wo", weight(p + "wo"))
         add("rms_att", reader.tensor(p + "rms_att").astype(np.float32))
         add("rms_ffn", reader.tensor(p + "rms_ffn").astype(np.float32))
         if cfg.is_moe:
@@ -89,6 +168,12 @@ def load_params(
             add("moe_up", cast(np.stack(ups)))
             add("moe_gate", cast(np.stack(gates)))
             add("moe_down", cast(np.stack(downs)))
+        elif quantized and tp > 1:
+            add("gate_up", sharded(shard_out, [p + "gate", p + "up"]))
+            add("down", sharded(shard_in, p + "down"))
+        elif quantized:
+            add("gate_up", weight_fused([p + "gate", p + "up"]))
+            add("down", weight(p + "down"))
         else:
             add("gate", weight(p + "gate"))
             add("down", weight(p + "down"))
@@ -112,11 +197,15 @@ def load_params(
         # the engine, via device_put — plain or with a NamedSharding under
         # TP — so no full copy ever lands on a single device's HBM first
         layers_out = {k: np.stack(vs) for k, vs in layers.items()}
+    if quantized and tp > 1 and cfg.vocab_size % tp == 0:
+        wcls = sharded(shard_out, ["wcls"])  # vocab-sharded logits head
+    else:
+        wcls = weight("wcls")
     return {
         "embedding": reader.tensor("embedding").astype(np.float32),
         "layers": layers_out,
         "rms_final": reader.tensor("rms_final").astype(np.float32),
-        "wcls": weight("wcls"),
+        "wcls": wcls,
         "rope_table": build_rope_table(cfg),
     }
 
@@ -200,11 +289,15 @@ def random_params_on_device(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0)
 
 
 def load_model(
-    path: str, dtype=jnp.bfloat16, max_seq_len: int | None = None, **cfg_overrides
+    path: str,
+    dtype=jnp.bfloat16,
+    max_seq_len: int | None = None,
+    tp: int = 1,
+    **cfg_overrides,
 ) -> tuple[ModelSpec, LlamaConfig, Params]:
     reader = ModelFileReader(path)
     spec = reader.spec.clamp_seq_len(max_seq_len)
     cfg = config_from_spec(spec, **cfg_overrides)
-    params = load_params(reader, cfg, dtype=dtype)
+    params = load_params(reader, cfg, dtype=dtype, tp=tp)
     reader.close()
     return spec, cfg, params

@@ -353,13 +353,46 @@ class ModelFileReader:
                 f"model file size mismatch: layout expects {expected} bytes, file has {self.spec.file_size}"
             )
         self._mmap = np.memmap(path, dtype=np.uint8, mode="r")
+        self.bytes_read = 0  # logical bytes served (sharded-load accounting)
 
     def names(self) -> list[str]:
         return list(self.entries)
 
     def raw(self, name: str) -> np.ndarray:
         e = self.entries[name]
+        self.bytes_read += e.nbytes
         return self._mmap[e.offset : e.offset + e.nbytes]
+
+    def raw_rows(self, name: str, row_start: int, row_end: int) -> np.ndarray:
+        """Raw bytes of a contiguous row (output-dim) range — the exact-repack
+        shard read for output-sharded Q40 matrices (the read-time analogue of
+        RowMatmulSlice, reference: src/commands.cpp:22-43)."""
+        e = self.entries[name]
+        n = e.shape[1]
+        row_bytes = tensor_bytes(e.float_type, n)
+        start = e.offset + row_start * row_bytes
+        nbytes = (row_end - row_start) * row_bytes
+        self.bytes_read += nbytes
+        return self._mmap[start : start + nbytes]
+
+    def raw_row_blocks(self, name: str, col_start: int, col_end: int) -> np.ndarray:
+        """Raw bytes of a column (input-dim) range of every row, sliced on
+        quant-block boundaries — the shard read for input-sharded Q40
+        matrices (ColMatmulSlice applied at read time, reference:
+        src/commands.cpp:57-73). Returns [d_out, col_bytes] bytes."""
+        from distributed_llama_tpu.quants import QK
+
+        e = self.entries[name]
+        d_out, d_in = e.shape
+        if col_start % QK or col_end % QK:
+            raise ValueError(f"column range ({col_start},{col_end}) not {QK}-aligned")
+        row_bytes = tensor_bytes(e.float_type, d_in)
+        lo = tensor_bytes(e.float_type, col_start)
+        hi = tensor_bytes(e.float_type, col_end)
+        rows = self._mmap[e.offset : e.offset + e.nbytes].reshape(d_out, row_bytes)
+        out = np.ascontiguousarray(rows[:, lo:hi])
+        self.bytes_read += out.nbytes
+        return out
 
     def tensor(self, name: str) -> np.ndarray:
         """Dequantized float32 tensor in its logical shape."""
@@ -383,6 +416,7 @@ class ModelFileReader:
         start = e.offset + row_start * row_bytes
         nrows = row_end - row_start
         buf = self._mmap[start : start + nrows * row_bytes]
+        self.bytes_read += nrows * row_bytes
         flat = deserialize_tensor(buf, e.float_type, nrows * n)
         return flat.reshape(nrows, n)
 

@@ -93,11 +93,22 @@ def attention(
     S = cache_l.shape[1]
     hd = cfg.head_size
     xn = rmsnorm(x, lp["rms_att"])
-    xc = xn.astype(lp["q"].dtype)
 
-    q = _matmul(xc, lp["q"])  # [T, Hl*hd] f32
-    k = _matmul(xc, lp["k"])  # [T, Kl*hd]
-    v = _matmul(xc, lp["v"])  # [T, Kl*hd]
+    if "qkv" in lp:
+        # q|k|v packed as one matmul on the output dim (the q40 path: one
+        # large bandwidth-efficient kernel call instead of three small ones)
+        xc = xn.astype(lp["qkv"].dtype)
+        fused = _matmul(xc, lp["qkv"])  # [T, (Hl+2*Kl)*hd] f32
+        d_q = lp["wo"].shape[-2]  # Hl*hd (wo's input dim)
+        d_kv = (fused.shape[-1] - d_q) // 2
+        q = fused[:, :d_q]
+        k = fused[:, d_q : d_q + d_kv]
+        v = fused[:, d_q + d_kv :]
+    else:
+        xc = xn.astype(lp["q"].dtype)
+        q = _matmul(xc, lp["q"])  # [T, Hl*hd] f32
+        k = _matmul(xc, lp["k"])  # [T, Kl*hd]
+        v = _matmul(xc, lp["v"])  # [T, Kl*hd]
     Hl = q.shape[-1] // hd
     Kl = k.shape[-1] // hd
     q = q.reshape(T, Hl, hd)
@@ -137,8 +148,15 @@ def attention(
 
 def ffn(cfg: LlamaConfig, x: jax.Array, lp: Params, axis_name: str | None) -> jax.Array:
     """SwiGLU FFN (reference: src/llama2-tasks.cpp:158-212)."""
-    xn = rmsnorm(x, lp["rms_ffn"]).astype(lp["gate"].dtype)
-    h = _activation(_matmul(xn, lp["gate"]), cfg.hidden_act) * _matmul(xn, lp["up"])
+    if "gate_up" in lp:
+        # gate|up packed as one matmul (see the qkv note in attention)
+        xn = rmsnorm(x, lp["rms_ffn"]).astype(lp["gate_up"].dtype)
+        fused = _matmul(xn, lp["gate_up"])
+        hidden = fused.shape[-1] // 2
+        h = _activation(fused[:, :hidden], cfg.hidden_act) * fused[:, hidden:]
+    else:
+        xn = rmsnorm(x, lp["rms_ffn"]).astype(lp["gate"].dtype)
+        h = _activation(_matmul(xn, lp["gate"]), cfg.hidden_act) * _matmul(xn, lp["up"])
     out = _matmul(h.astype(lp["down"].dtype), lp["down"])
     if axis_name is not None:
         out = jax.lax.psum(out, axis_name)

@@ -44,6 +44,44 @@ def sample_token(
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def decode_scan(
+    cfg: LlamaConfig,
+    params,
+    first_token: jax.Array,  # int32 scalar
+    cache: jax.Array,
+    pos: jax.Array,  # int32 scalar: position of first_token
+    key: jax.Array,
+    n_steps: int,
+    temperature: float,
+    topp: float,
+    axis_name: str | None = None,
+):
+    """The un-jitted decode scan body: forward → sample → feed back.
+
+    With ``axis_name`` set it is the per-shard SPMD body for a shard_map'd
+    tensor-parallel decode: the forward psums ride the mesh, a vocab-sharded
+    logits head is all-gathered, and sampling runs identically on every
+    shard (same key → same token everywhere).
+    """
+
+    def step(carry, _):
+        token, cache, p, k = carry
+        logits, cache = llama.forward_tokens(
+            cfg, params, token[None], cache, p, axis_name=axis_name
+        )
+        if axis_name is not None and logits.shape[-1] != cfg.vocab_size:
+            logits = jax.lax.all_gather(logits, axis_name, axis=1, tiled=True)
+        k, sub = jax.random.split(k)
+        nxt = sample_token(logits[0], sub, temperature, topp)
+        return (nxt, cache, p + 1, k), nxt
+
+    (_, cache, _, _), tokens = jax.lax.scan(
+        step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32), key), None,
+        length=n_steps,
+    )
+    return tokens, cache
+
+
 @functools.partial(
     jax.jit, static_argnums=(0, 5, 6, 7), donate_argnums=(3,)
 )
@@ -58,23 +96,13 @@ def decode_loop(
     topp: float,
     key: jax.Array | None = None,
 ):
-    """Generate ``n_steps`` tokens autoregressively on device.
+    """Generate ``n_steps`` tokens autoregressively on device (single chip).
 
     Returns (tokens [n_steps] int32, final cache). tokens[i] is the token
     sampled after consuming the token at position pos+i.
     """
     if key is None:
         key = jax.random.PRNGKey(0)
-
-    def step(carry, _):
-        token, cache, p, k = carry
-        logits, cache = llama.forward_tokens(cfg, params, token[None], cache, p)
-        k, sub = jax.random.split(k)
-        nxt = sample_token(logits[0], sub, temperature, topp)
-        return (nxt, cache, p + 1, k), nxt
-
-    (_, cache, _, _), tokens = jax.lax.scan(
-        step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32), key), None,
-        length=n_steps,
+    return decode_scan(
+        cfg, params, first_token, cache, pos, key, n_steps, temperature, topp
     )
-    return tokens, cache

@@ -33,10 +33,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from distributed_llama_tpu.quants import QK
 
-# Tile sizes tuned on v5e: (512, 1024) runs a 4096x4096 T=1 matvec in ~52us
-# (vs ~1.4ms at (256, 256) — the grid-step overhead dominates small tiles).
-# Larger bd tiles exceed VMEM with the dequantized bf16 weight tile.
-BLOCK_N = 512  # input-dim tile (must be a multiple of 32)
+# Tile sizes tuned on v5e (slope-timed to exclude the remote tunnel's fixed
+# dispatch cost): with the split-x kernel, (1024, 1024) runs a 4096x11008
+# T=1 matvec at ~300 GB/s of packed bytes vs ~45 GB/s for the old
+# interleaving kernel. Small divisor tiles (256x256) are ~10x slower — the
+# per-grid-step overhead dominates.
+BLOCK_N = 1024  # input-dim tile (must be a multiple of 32)
 BLOCK_D = 1024  # output-dim tile (must be a multiple of 128)
 
 
@@ -132,7 +134,7 @@ def pack_q40_tpu(file_qs: np.ndarray, file_scales: np.ndarray, shape: tuple[int,
         fast = native.q40_repack_tpu(raw.reshape(-1), d_out, d_in)
         if fast is not None:
             packed_n, scales_n = fast
-            return QuantizedMatrix(qs=jnp.asarray(packed_n), scales=jnp.asarray(scales_n))
+            return _pad_packed(packed_n, scales_n, d_in, d_out)
     except Exception:
         pass
     qs = file_qs.reshape(d_out, blocks_per_row, QK // 2)
@@ -170,14 +172,44 @@ def pack_q40_raw(raw: np.ndarray | bytes, shape: tuple[int, int]) -> QuantizedMa
 def quantize_q40_tpu(w: np.ndarray) -> QuantizedMatrix:
     """Quantize a float matrix W [n, d] (already in x@W orientation) directly
     to the TPU layout. Quantization blocks run along the input dim n,
-    mirroring the file format's along-row blocks after transpose."""
+    mirroring the file format's along-row blocks after transpose. An odd
+    output dim is zero-padded to even (nibble pairing needs row pairs)."""
     from distributed_llama_tpu.quants import quantize_q40
 
     n, d = w.shape
+    d_even = d + (d % 2)
+    if d_even != d:
+        w = np.pad(w, ((0, 0), (0, 1)))
     qs_file, scales_file = quantize_q40(np.ascontiguousarray(w.T))  # blocks along n
-    return pack_q40_tpu(
-        qs_file.reshape(-1, QK // 2), scales_file.reshape(-1), (d, n)
+    qm = pack_q40_tpu(
+        qs_file.reshape(-1, QK // 2), scales_file.reshape(-1), (d_even, n)
     )
+    if d_even != d:
+        qm = QuantizedMatrix(qm.qs, qm.scales, n_logical=qm.n, d_logical=d)
+    return qm
+
+
+def concat_shard_packs(mats: list[QuantizedMatrix], axis: str) -> QuantizedMatrix:
+    """Assemble per-shard packs into ONE host-layout matrix whose equal-size
+    blocks along the sharded axis are the shards, so a ``device_put`` with a
+    ``NamedSharding`` places each shard's pack on its device verbatim.
+
+    ``axis``: "out" for output-dim (column) shards (qkv / gate_up / wcls —
+    RowMatmulSlice layout, reference: src/commands.cpp:11-43), "in" for
+    input-dim (row) shards (wo / down — ColMatmulSlice, :45-73).
+
+    The returned aux dims (n_logical/d_logical) are the PER-SHARD logical
+    dims: the matrix is only ever consumed inside shard_map, where each
+    device sees exactly one shard's block.
+    """
+    m0 = mats[0]
+    for m in mats[1:]:
+        if m.qs.shape != m0.qs.shape or (m.n, m.d) != (m0.n, m0.d):
+            raise ValueError("shard packs must be identically shaped")
+    ax = -1 if axis == "out" else -2
+    qs = np.concatenate([np.asarray(m.qs) for m in mats], axis=ax)
+    scales = np.concatenate([np.asarray(m.scales) for m in mats], axis=ax)
+    return QuantizedMatrix(qs, scales, n_logical=m0.n, d_logical=m0.d)
 
 
 def dequantize_tpu(qm: QuantizedMatrix) -> np.ndarray:
@@ -193,33 +225,47 @@ def dequantize_tpu(qm: QuantizedMatrix) -> np.ndarray:
     return (vals.astype(np.float32) * scale_full)[: qm.n, : qm.d]
 
 
-def _q40_matmul_kernel(x_ref, qs_ref, scales_ref, out_ref, acc_ref):
-    """One (d-tile, n-tile) grid step: dequantize the weight tile in VMEM and
-    accumulate x_tile @ w_tile into the f32 accumulator."""
-    j = pl.program_id(1)
+def _make_q40_kernel(compute_dtype):
+    """Kernel factory: one (d-tile, n-tile) grid step dequantizes the weight
+    tile in VMEM and accumulates into the f32 accumulator.
 
-    @pl.when(j == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    The packed tile's low nibbles are even input rows, high nibbles odd rows.
+    Instead of interleaving them back to natural order (a sublane relayout
+    that dominated the old kernel's runtime, ~6x slower), the caller splits x
+    into even/odd columns once outside and the kernel runs two half-size dots
+    — a matmul's contraction is permutation-invariant when both operands are
+    permuted alike.
 
-    qs = qs_ref[:].astype(jnp.int32)  # [bn/2, bd]; mosaic has no u8->f32 cast
-    # dequantize to bf16: Q40's own quantization noise (~1-2%) dwarfs bf16
-    # round-off, and bf16 halves both VMEM footprint and VPU work
-    lo = (qs & 0xF).astype(jnp.bfloat16) - 8.0
-    hi = ((qs >> 4) & 0xF).astype(jnp.bfloat16) - 8.0
-    # interleave rows back to [bn, bd]: row 2i = lo[i], row 2i+1 = hi[i]
-    w_int = jnp.stack([lo, hi], axis=1).reshape(qs.shape[0] * 2, qs.shape[1])
-    scales = scales_ref[:]  # [bn/32, bd]
-    w = w_int.reshape(-1, QK, qs.shape[1]) * scales[:, None, :].astype(jnp.bfloat16)
-    w = w.reshape(qs.shape[0] * 2, qs.shape[1])
+    ``compute_dtype`` is bf16 on TPU (Q40's quantization noise dwarfs bf16
+    round-off, and bf16 halves VMEM footprint and VPU work) and f32 in
+    interpret mode (XLA:CPU cannot execute bf16 x bf16 dots)."""
 
-    x = x_ref[:].astype(jnp.bfloat16)  # [T, bn]
-    acc_ref[:] += jnp.dot(x, w, preferred_element_type=jnp.float32)
+    def kernel(xe_ref, xo_ref, qs_ref, scales_ref, out_ref, acc_ref):
+        j = pl.program_id(1)
 
-    @pl.when(j == pl.num_programs(1) - 1)
-    def _():
-        out_ref[:] = acc_ref[:]
+        @pl.when(j == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
+        qs = qs_ref[:].astype(jnp.int32)  # [bn/2, bd]; mosaic has no u8->f32 cast
+        lo = (qs & 0xF).astype(compute_dtype) - 8.0
+        # qs holds u8 values, so >>4 is already in 0..15 — no mask needed
+        # (dropping the redundant & 0xF is worth ~25% on the VPU-bound unpack)
+        hi = (qs >> 4).astype(compute_dtype) - 8.0
+        s = scales_ref[:].astype(compute_dtype)  # [bn/32, bd]
+        bn2, bd = qs.shape
+        # packed row i = logical rows (2i, 2i+1), both in 32-block i//16: the
+        # scale row broadcasts over 16 packed rows for lo and hi alike
+        wlo = (lo.reshape(-1, 16, bd) * s[:, None, :]).reshape(bn2, bd)
+        whi = (hi.reshape(-1, 16, bd) * s[:, None, :]).reshape(bn2, bd)
+        acc_ref[:] += jnp.dot(xe_ref[:], wlo, preferred_element_type=jnp.float32)
+        acc_ref[:] += jnp.dot(xo_ref[:], whi, preferred_element_type=jnp.float32)
+
+        @pl.when(j == pl.num_programs(1) - 1)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
@@ -236,9 +282,9 @@ def q40_matmul(
     n, d = qm.n, qm.d
     np_, dp = qm.n_padded, qm.d_padded
     T = x.shape[0]
-    # VMEM budget (measured on v5e, 16MB scoped limit): (512, 1024) fits for
-    # decode-sized T but overflows ~17.5MB at T=64; shrink the output tile as
-    # T grows
+    # VMEM budget (measured on v5e, 16MB scoped limit): the dominant tiles
+    # are the int32 + 2x bf16 dequant forms (~8 B per packed element) plus
+    # the [T, bd] f32 accumulator; shrink the output tile as T grows
     if T > 8:
         block_d = min(block_d, 512)
     if T > 256:
@@ -255,12 +301,17 @@ def q40_matmul(
 
     if x.shape[-1] != np_:
         x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
+    compute_dtype = jnp.float32 if interpret else jnp.bfloat16
+    xb = x.astype(compute_dtype)
+    xe = xb[:, 0::2]  # pairs with the low nibbles (logical rows 2i)
+    xo = xb[:, 1::2]  # pairs with the high nibbles (logical rows 2i+1)
     grid = (dp // block_d, np_ // block_n)
     out = pl.pallas_call(
-        _q40_matmul_kernel,
+        _make_q40_kernel(compute_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((T, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
+            pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
             pl.BlockSpec((block_n // 2, block_d), lambda i, j: (j, i)),
             pl.BlockSpec((block_n // QK, block_d), lambda i, j: (j, i)),
         ],
@@ -271,7 +322,7 @@ def q40_matmul(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(x, qm.qs, qm.scales)
+    )(xe, xo, qm.qs, qm.scales)
     return out[:, :d] if dp != d else out
 
 

@@ -44,7 +44,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
-def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+def validate_tp(cfg: LlamaConfig, tp: int, quantized: bool = False) -> None:
     """The sharding-divisibility constraint, enforced like the reference's
     nSlices checks (reference: src/transformer.cpp:105-111)."""
     if tp & (tp - 1):
@@ -56,6 +56,17 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
     ):
         if value % tp != 0:
             raise ValueError(f"tp={tp} must divide {name}={value}")
+    if quantized:
+        # input-dim shards must land on 32-wide quant-block boundaries,
+        # like ColMatmulSlice's n % (nSlices*blockSize) requirement
+        # (reference: src/commands.cpp:49-56)
+        from distributed_llama_tpu.quants import QK
+
+        for name, value in (("dim", cfg.dim), ("hidden_dim", cfg.hidden_dim)):
+            if value % (tp * QK) != 0:
+                raise ValueError(
+                    f"q40 tp={tp} needs {name}={value} divisible by {tp * QK}"
+                )
 
 
 def layer_param_specs(cfg: LlamaConfig) -> dict[str, P]:
@@ -96,23 +107,67 @@ def param_specs(cfg: LlamaConfig, shard_vocab: bool) -> dict[str, Any]:
     }
 
 
+def q40_layer_specs(cfg: LlamaConfig) -> dict[str, P]:
+    """PartitionSpecs for ONE layer of the q40 per-layer-list layout
+    (fused qkv/gate_up, QuantizedMatrix leaves — a spec here is a pytree
+    prefix covering both the qs and scales arrays, which shard alike)."""
+    specs: dict[str, P] = {
+        "qkv": P(None, "tp"),  # output-dim sharded (q|k|v each split 1/tp)
+        "wo": P("tp", None),  # input-dim sharded
+        "rms_att": P(None),
+        "rms_ffn": P(None),
+    }
+    if cfg.is_moe:
+        specs.update(
+            router=P(None, None),
+            moe_up=P(None, None, "tp"),  # [E, D, Hl] bf16 expert banks
+            moe_gate=P(None, None, "tp"),
+            moe_down=P(None, "tp", None),
+        )
+    else:
+        specs.update(gate_up=P(None, "tp"), down=P("tp", None))
+    if cfg.arch.name == "GROK1":
+        specs.update(rms_moe=P(None), rms_ffn2=P(None))
+    return specs
+
+
+def q40_param_specs(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[str, Any]:
+    return {
+        "embedding": P(None, None),
+        "layers": [q40_layer_specs(cfg) for _ in range(n_layers)],
+        "rms_final": P(None),
+        "wcls": P(None, "tp") if shard_vocab else P(None, None),
+        "rope_table": P(None, None, None),
+    }
+
+
 CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
 
 
 class TensorParallelForward:
-    """Jitted shard_map'd forward over a 1-D ``tp`` mesh."""
+    """Jitted shard_map'd forward over a 1-D ``tp`` mesh.
 
-    def __init__(self, cfg: LlamaConfig, tp: int, devices=None):
-        validate_tp(cfg, tp)
+    ``quantized=True`` switches the param layout to the q40 per-layer list
+    (fused qkv/gate_up QuantizedMatrix leaves, built in sharded layout by
+    ``engine.weights.load_params(tp=...)``).
+    """
+
+    def __init__(self, cfg: LlamaConfig, tp: int, devices=None, quantized: bool = False):
+        validate_tp(cfg, tp, quantized=quantized)
         self.cfg = cfg
         self.tp = tp
+        self.quantized = quantized
         if devices is None:
             devices = jax.devices()[:tp]
         if len(devices) < tp:
             raise ValueError(f"need {tp} devices, have {len(devices)}")
         self.mesh = Mesh(mesh_utils.create_device_mesh((tp,), devices=devices), ("tp",))
         self.shard_vocab = cfg.vocab_size % tp == 0
-        self._specs = param_specs(cfg, self.shard_vocab)
+        self._decode_cache: dict = {}
+        if quantized:
+            self._specs = q40_param_specs(cfg, cfg.n_layers, self.shard_vocab)
+        else:
+            self._specs = param_specs(cfg, self.shard_vocab)
 
         fn = functools.partial(self._step, cfg)
         mapped = shard_map(
@@ -137,14 +192,64 @@ class TensorParallelForward:
     # ------------------------------------------------------------------
 
     def shard_params(self, host_params) -> Any:
-        # explicit recursion: PartitionSpec is a tuple subclass, so tree.map
-        # over the spec tree would descend into the specs themselves
+        from distributed_llama_tpu.ops.q40 import QuantizedMatrix
+
+        # explicit recursion: PartitionSpec is a tuple subclass (and
+        # QuantizedMatrix a custom node), so tree.map over the spec tree
+        # would descend into the specs themselves
         def rec(p, s):
             if isinstance(p, dict):
                 return {k: rec(p[k], s[k]) for k in p}
+            if isinstance(p, list):
+                return [rec(pi, si) for pi, si in zip(p, s)]
+            if isinstance(p, QuantizedMatrix):
+                # one spec covers both leaves: qs [n/2, d] and scales
+                # [n/32, d] shard along the same axis index
+                ns = NamedSharding(self.mesh, s)
+                return QuantizedMatrix(
+                    jax.device_put(p.qs, ns),
+                    jax.device_put(p.scales, ns),
+                    p.n_logical,
+                    p.d_logical,
+                )
             return jax.device_put(p, NamedSharding(self.mesh, s))
 
         return rec(host_params, self._specs)
+
+    def _decode_jitted(self, n_steps: int, temperature: float, topp: float):
+        # per-instance cache (an lru_cache on the method would pin self and
+        # its compiled executables in a class-level cache for process life)
+        key = (n_steps, temperature, topp)
+        cached = self._decode_cache.get(key)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.models import sampling
+
+        fn = functools.partial(
+            sampling.decode_scan,
+            self.cfg,
+            n_steps=n_steps,
+            temperature=temperature,
+            topp=topp,
+            axis_name="tp",
+        )
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), CACHE_SPEC, P(), P()),
+            out_specs=(P(), CACHE_SPEC),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._decode_cache[key] = jitted
+        return jitted
+
+    def decode_loop(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
+        """On-device autoregressive decode under TP: ONE dispatch for
+        ``n_steps`` tokens, collectives riding the mesh every step. Sampling
+        runs replicated (same key → same token on every shard)."""
+        jitted = self._decode_jitted(int(n_steps), float(temperature), float(topp))
+        return jitted(params, jnp.asarray(first_token), cache, jnp.asarray(pos), key)
 
     def init_cache(self, dtype=jnp.float32):
         shape = (

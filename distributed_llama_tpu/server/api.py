@@ -108,7 +108,12 @@ class ApiState:
         prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
         seq_len = engine.cfg.seq_len
         budget = seq_len - engine.pos
-        prompt_tokens = prompt_tokens[:budget]
+        if len(prompt_tokens) > budget:
+            print(
+                f"⚠️ prompt truncated: {len(prompt_tokens)} tokens > "
+                f"{budget} remaining context (seq_len {seq_len})"
+            )
+            prompt_tokens = prompt_tokens[:budget]
         prompt_end = start_pos + len(prompt_tokens)
         for m in delta_messages:
             self.cache.push(prompt_end, m["role"], m["content"])
@@ -133,6 +138,7 @@ class ApiState:
         buffer = []
         prev = prompt_tokens[-1]
         pos = engine.pos
+        finish_reason = "length"  # overwritten on EOS/stop exit
         while pos < max_pos:
             token = self.sampler.sample(logits)
             piece = tokenizer.decode_piece(prev, token)
@@ -146,10 +152,20 @@ class ApiState:
                         send_chunk(self._chunk_json(text, stop=False))
                 detector.clear()
             if res == EosDetectorResult.EOS:
+                finish_reason = "stop"
                 break
             logits = engine.decode_step(token)
             prev = token
             pos = engine.pos
+        else:
+            # length-limited exit: flush text held back as a possible stop-
+            # string prefix (MAYBE_EOS) so the response tail is not lost
+            tail = detector.flush_delta()
+            if tail:
+                text = tail.decode("utf-8", errors="replace")
+                buffer.append(text)
+                if stream:
+                    send_chunk(self._chunk_json(text, stop=False))
 
         content = "".join(buffer)
         if engine.pos >= seq_len:
@@ -158,7 +174,7 @@ class ApiState:
             self.cache.push(engine.pos, "assistant", content)
 
         if stream:
-            send_chunk(self._chunk_json("", stop=True))
+            send_chunk(self._chunk_json("", stop=True, finish_reason=finish_reason))
             send_chunk("[DONE]")
             return None
         n_completion = engine.pos - prompt_end
@@ -176,13 +192,13 @@ class ApiState:
                 {
                     "index": 0,
                     "message": {"role": "assistant", "content": content},
-                    "finish_reason": "stop",
+                    "finish_reason": finish_reason,
                 }
             ],
         }
 
-    def _chunk_json(self, delta_text: str, stop: bool) -> str:
-        choice: dict = {"index": 0, "finish_reason": "stop" if stop else ""}
+    def _chunk_json(self, delta_text: str, stop: bool, finish_reason: str = "stop") -> str:
+        choice: dict = {"index": 0, "finish_reason": finish_reason if stop else ""}
         choice["delta"] = (
             {"role": "", "content": ""}
             if stop

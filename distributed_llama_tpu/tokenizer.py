@@ -166,12 +166,21 @@ class Tokenizer:
 
 
 def is_safe_piece(piece: bytes) -> bool:
-    """Filter lone unprintable bytes (reference: src/tokenizer.cpp:19-31)."""
+    """Filter lone control bytes (reference: src/tokenizer.cpp:19-31).
+
+    Deliberate deviation from the reference's C-locale isprint: lone bytes
+    >= 0x80 are KEPT — they are byte-fallback fragments of multi-byte UTF-8
+    (e.g. 'é' emitted as <0xC3><0xA9>) that downstream byte buffers
+    (EosDetector, the API chunker) reassemble into real characters; the
+    reference silently drops them. Lone ASCII control bytes (except
+    whitespace) and DEL are still unsafe."""
     if not piece:
         return False
     if len(piece) == 1:
         b = piece[0]
-        return chr(b).isprintable() or chr(b).isspace()
+        if b < 0x20:
+            return b in (0x09, 0x0A, 0x0B, 0x0C, 0x0D)
+        return b != 0x7F
     return True
 
 
@@ -422,6 +431,14 @@ class EosDetector:
         if self.eos_pos == 0:
             return None
         return bytes(self.buffer[: self.eos_pos])
+
+    def flush_delta(self) -> bytes:
+        """Drain buffered text on a non-EOS exit (length/context limit):
+        text held back as a possible stop-string prefix (MAYBE_EOS) would
+        otherwise be silently dropped. Clears the buffer."""
+        delta = self.get_delta() or b""
+        self.clear()
+        return delta
 
     def clear(self) -> None:
         self.buffer = bytearray()

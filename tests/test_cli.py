@@ -73,3 +73,22 @@ class TestCli:
         )
         out = capsys.readouterr().out
         assert "Generated tokens:" in out
+
+    def test_q40_dtype(self, model_files, capsys):
+        """The documented production command: 4-bit weights from the CLI
+        (reference quick start uses --weights-float-type q40)."""
+        model, tok = model_files
+        run_cli(
+            ["generate", "--model", model, "--tokenizer", tok, "--prompt", "hello",
+             "--steps", "6", "--temperature", "0", "--dtype", "q40"]
+        )
+        out = capsys.readouterr().out
+        assert "Generated tokens:" in out
+
+    def test_kv_cache_storage_disc_rejected(self, model_files):
+        model, tok = model_files
+        with pytest.raises(SystemExit, match="kv-cache-storage"):
+            run_cli(
+                ["generate", "--model", model, "--tokenizer", tok, "--prompt", "x",
+                 "--steps", "2", "--kv-cache-storage", "disc"]
+            )

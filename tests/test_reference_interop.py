@@ -1,0 +1,191 @@
+"""End-to-end interop against the reference C++ binary.
+
+Builds the reference engine (``make dllama``), writes a tiny Q40 ``.m`` +
+``.t`` with OUR public writers, runs ``dllama generate`` greedy, and asserts
+our engine produces the exact same text. This is the strongest parity
+evidence available: it proves the file layouts byte-match what the reference
+loader expects (reference: src/transformer.cpp:12-148, src/tokenizer.cpp:39-138)
+AND that the forward math agrees to argmax stability.
+
+Auto-skips when the reference tree or a C++ toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer_file
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.tokenizer import Tokenizer
+
+
+def c_safe_piece(piece: bytes) -> bool:
+    """The reference's exact safePrintf filter (C-locale isprint/isspace,
+    src/tokenizer.cpp:19-31) — used HERE so our replayed loop byte-matches
+    the reference's stdout; the production is_safe_piece deliberately keeps
+    >=0x80 UTF-8 fragments the reference drops."""
+    if not piece:
+        return False
+    if len(piece) == 1:
+        b = piece[0]
+        return 0x20 <= b <= 0x7E or b in (0x09, 0x0A, 0x0B, 0x0C, 0x0D)
+    return True
+
+from tests.model_utils import random_tensors, tiny_spec, write_model_file
+from tests.test_tokenizer import make_sentencepiece_like_tokenizer
+
+REFERENCE_DIR = "/root/reference"
+BUILD_DIR = "/tmp/refbuild-interop"
+
+# reference kernels assert divisibility (matmulQ40: n % 32, AVX2 paths % 8,
+# thread splits) — 256-multiples satisfy all of them (verify-skill recipe)
+DIM = 256
+HIDDEN = 512
+VOCAB = 512
+
+
+@pytest.fixture(scope="module")
+def dllama_bin():
+    if not os.path.isdir(REFERENCE_DIR):
+        pytest.skip("reference tree not available")
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("C++ toolchain not available")
+    binpath = os.path.join(BUILD_DIR, "dllama")
+    if not os.path.exists(binpath):
+        shutil.rmtree(BUILD_DIR, ignore_errors=True)
+        shutil.copytree(REFERENCE_DIR, BUILD_DIR)
+        try:
+            subprocess.run(
+                ["make", "dllama"],
+                cwd=BUILD_DIR,
+                capture_output=True,
+                timeout=600,
+                check=True,
+            )
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+            pytest.skip(f"reference build failed: {e}")
+    return binpath
+
+
+def make_interop_tokenizer(vocab_size: int) -> Tokenizer:
+    """The sentencepiece-like test vocab padded to the model's vocab size
+    (the reference samples ids from the model header's vocabSize)."""
+    base = make_sentencepiece_like_tokenizer().data
+    vocab = list(base.vocab)
+    scores = list(base.scores)
+    while len(vocab) < vocab_size:
+        vocab.append(f"<pad{len(vocab)}>".encode())
+        scores.append(-30.0)
+    return Tokenizer(
+        TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=2)
+    )
+
+
+@pytest.fixture(scope="module")
+def interop_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("interop")
+    spec = tiny_spec(
+        dim=DIM,
+        hidden_dim=HIDDEN,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,
+        vocab_size=VOCAB,
+        seq_len=32,
+        weights_float_type=FloatType.Q40,
+    )
+    tensors = random_tensors(spec, seed=3)
+    model_path = str(tmp / "interop.m")
+    tok_path = str(tmp / "interop.t")
+    write_model_file(model_path, spec, tensors)
+    tok = make_interop_tokenizer(VOCAB)
+    with open(tok_path, "wb") as f:
+        write_tokenizer_file(f, tok.data)
+    return model_path, tok_path, tok
+
+
+def reference_generate(binpath, model, tok, prompt: str, steps: int) -> str:
+    """Run the reference greedy and return the generated text (pieces only)."""
+    out = subprocess.run(
+        [
+            binpath,
+            "generate",
+            "--model",
+            model,
+            "--tokenizer",
+            tok,
+            "--prompt",
+            prompt,
+            "--steps",
+            str(steps),
+            "--nthreads",
+            "2",
+            "--temperature",
+            "0.0",
+            "--buffer-float-type",
+            "f32",
+            "--seed",
+            "1",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, f"reference run failed:\n{out.stdout}\n{out.stderr}"
+    assert "missing" not in out.stdout, out.stdout  # "file is missing N bytes"
+    # generate mode prints the spec dump (one line each), then all pieces on
+    # one line (safePrintf never emits newlines), then the stats block
+    text = out.stdout.split("\nGenerated tokens:")[0]
+    return text.splitlines()[-1]
+
+
+def our_generate(model, tok: Tokenizer, prompt: str, steps: int) -> str:
+    """Replicate the reference's generate loop exactly
+    (reference: src/apps/dllama/dllama.cpp:17-94): feed one token per
+    position, force prompt tokens during prefill, greedy-sample after,
+    stop on BOS, print decode(token, next) per step."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.engine import InferenceEngine
+
+    engine = InferenceEngine(model, dtype=jnp.float32)
+    prompt_tokens = tok.encode(prompt, add_bos=True)
+    token = prompt_tokens[0]
+    pieces = []
+    pos = 0
+    while pos < steps:
+        logits = engine.forward([token])[0]
+        if pos < len(prompt_tokens) - 1:
+            nxt = prompt_tokens[pos + 1]
+        else:
+            nxt = int(np.argmax(logits))
+        pos += 1
+        if nxt == tok.bos_id:
+            break
+        piece = tok.decode_piece(token, nxt)
+        if c_safe_piece(piece):
+            pieces.append(piece.decode("utf-8", errors="replace"))
+        token = nxt
+    return "".join(pieces)
+
+
+class TestReferenceInterop:
+    def test_greedy_text_matches(self, dllama_bin, interop_files):
+        model, tok_path, tok = interop_files
+        prompt = "hello world"
+        steps = 16
+        ref_text = reference_generate(dllama_bin, model, tok_path, prompt, steps)
+        our_text = our_generate(model, tok, prompt, steps)
+        assert our_text == ref_text
+
+    def test_reference_loads_our_q40_file(self, dllama_bin, interop_files):
+        """Layout check in isolation: the reference must run the file at all
+        (a layout bug dies with 'The model file is missing N bytes')."""
+        model, tok_path, _ = interop_files
+        text = reference_generate(dllama_bin, model, tok_path, "abc", 8)
+        assert len(text) > 0

@@ -81,6 +81,21 @@ class TestTensorParallel:
         shard_shapes = {s.data.shape for s in engine.cache.addressable_shards}
         assert shard_shapes == {(2, 2, 24, 2, 8)}  # K axis 8/4=2 per shard
 
+    def test_tp_on_device_decode_matches_dense(self, tmp_path):
+        """The shard_map'd decode loop (one dispatch for N tokens,
+        collectives every step) greedy-matches the single-device loop."""
+        spec = spec_8heads()
+        tensors = random_tensors(spec, seed=4)
+        path = str(tmp_path / "model.m")
+        write_model_file(path, spec, tensors)
+        e1 = InferenceEngine(path, dtype=jnp.float32)
+        e1.prefill([1, 2, 3])
+        want = np.asarray(e1.generate_on_device(4, 6, temperature=0.0))
+        e4 = InferenceEngine(path, dtype=jnp.float32, tp=4)
+        e4.prefill([1, 2, 3])
+        got = np.asarray(e4.generate_on_device(4, 6, temperature=0.0))
+        np.testing.assert_array_equal(got, want)
+
     def test_validate_tp_rejects_bad_configs(self):
         from distributed_llama_tpu.models.config import config_from_spec
 
