@@ -197,6 +197,39 @@ class InferenceEngine:
         self.pos += n_steps
         return tokens
 
+    def generate_chunks(
+        self,
+        first_token: int,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+        chunk: int = 16,
+    ):
+        """Generator of on-device-decoded tokens: ``chunk`` tokens per device
+        dispatch (no per-token host round trip), host code between chunks.
+        ``first_token`` is consumed first, not yielded. Runs until the
+        context is exhausted — callers that stop early (EOS, stop string,
+        step budget) MUST ``rollback(pos)`` to the stream position after the
+        last token they consumed; the overshot cache slots are unreachable
+        after rollback.
+
+        This is the user-facing fast path: the stepwise ``decode_step`` loop
+        pays a host<->device round trip per token (the reference's regime,
+        src/apps/dllama/dllama.cpp:45-59), which behind a remote PJRT tunnel
+        costs more than the forward pass itself.
+        """
+        token = int(first_token)
+        drawn = 0
+        while self.pos < self.cfg.seq_len:
+            k = min(chunk, self.cfg.seq_len - self.pos)
+            toks = np.asarray(
+                self.generate_on_device(token, k, temperature, topp, seed=seed + drawn)
+            )
+            for t in toks.tolist():
+                yield int(t)
+            drawn += k
+            token = int(toks[-1])
+
     # ------------------------------------------------------------------
     # Stats (reference: Inference::getStats, src/tasks.cpp:186-189)
     # ------------------------------------------------------------------
