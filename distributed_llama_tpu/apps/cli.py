@@ -56,6 +56,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--chat-template", default=None,
                    choices=[None, "llama2", "llama3", "zephyr", "chatml"])
+    p.add_argument(
+        "--decode",
+        choices=["device", "host"],
+        default="device",
+        help="device = chunked on-device decode+sampling (fast path, jax.random); "
+        "host = per-token host sampling (the reference's regime, xorshift-parity "
+        "sampler, one host<->device round trip per token)",
+    )
+    p.add_argument(
+        "--decode-chunk", type=int, default=16,
+        help="tokens per device dispatch for --decode device",
+    )
     # accepted-for-parity flags (see module docstring)
     p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
@@ -105,9 +117,12 @@ def _print(s: str) -> None:
 def generate(args, benchmark: bool) -> None:
     """The generate/inference loop (reference: src/apps/dllama/dllama.cpp:17-94).
 
-    TPU-first deviation: the prompt is prefilled in one batched forward
-    instead of token-by-token; the per-token stats lines cover the decode
-    phase, prefill is reported as its own line.
+    TPU-first deviations: the prompt is prefilled in one batched forward
+    instead of token-by-token (per-token stats lines cover the decode phase,
+    prefill is its own line), and with ``--decode device`` (the default) the
+    decode loop runs on device in chunks — sampling included — so no
+    host<->device round trip is paid per token. ``--decode host`` restores
+    the reference's regime (host xorshift sampler, stepwise).
     """
     if args.prompt is None:
         raise SystemExit("Prompt is required")
@@ -128,28 +143,51 @@ def generate(args, benchmark: bool) -> None:
     if benchmark:
         _print("\n")
 
-    token = prompt_tokens[-1]
-    generated = 0
-    while True:
-        next_token = sampler.sample(logits)
-        if next_token == tokenizer.bos_id:
-            break  # BOS delimits sequences (reference: dllama.cpp:68-71)
+    def emit(prev: int, tok: int) -> None:
         stats = engine.stats[-1]
-        piece = tokenizer.decode_piece(token, next_token)
         if benchmark:
             _print(
                 f"🔶 G {stats.generation_ms:4.0f} ms I {stats.inference_ms:4.0f} ms "
                 f"T {stats.transfer_ms:4.0f} ms "
             )
+        piece = tokenizer.decode_piece(prev, tok)
         if is_safe_piece(piece):
             _print(piece.decode("utf-8", errors="replace"))
         if benchmark:
             _print("\n")
+
+    token = prompt_tokens[-1]
+    generated = 0
+    # first generated token always samples on host from the prefill logits
+    next_token = sampler.sample(logits)
+    if next_token != tokenizer.bos_id:  # BOS delimits sequences (dllama.cpp:68-71)
+        emit(token, next_token)
         generated += 1
         token = next_token
-        if engine.pos >= args.steps:
-            break
-        logits = engine.decode_step(token)
+        if args.decode == "device":
+
+            def on_token(prev: int, t: int) -> bool:
+                nonlocal generated, token
+                if t == tokenizer.bos_id:
+                    return False  # BOS delimits sequences (dllama.cpp:68-71)
+                emit(prev, t)
+                generated += 1
+                token = t
+                return True
+
+            engine.stream_decode(
+                token, on_token, args.temperature, args.topp,
+                seed=sampler.seed, chunk=args.decode_chunk, limit=args.steps,
+            )
+        else:
+            while engine.pos < args.steps:
+                logits = engine.decode_step(token)
+                next_token = sampler.sample(logits)
+                if next_token == tokenizer.bos_id:
+                    break
+                emit(token, next_token)
+                generated += 1
+                token = next_token
 
     avg = engine.avg_stats()
     total_ms = (time.perf_counter() - total_start) * 1000.0
@@ -193,9 +231,8 @@ def chat(args) -> None:
         detector = EosDetector(
             {tokenizer.chat_eos_id}, stops, padding_left=max_stop, padding_right=max_stop
         )
-        prev = tokens[-1]
-        while engine.pos < seq_len:
-            token = sampler.sample(logits)
+
+        def feed(prev: int, token: int) -> EosDetectorResult:
             piece = tokenizer.decode_piece(prev, token)
             res = detector.append(token, piece if is_safe_piece(piece) else b"")
             if res in (EosDetectorResult.NOT_EOS, EosDetectorResult.EOS):
@@ -203,11 +240,36 @@ def chat(args) -> None:
                 if delta:
                     _print(delta.decode("utf-8", errors="replace"))
                 detector.clear()
-            if res == EosDetectorResult.EOS:
-                break
-            logits = engine.decode_step(token)
-            prev = token
-        else:
+            return res
+
+        prev = tokens[-1]
+        token = sampler.sample(logits)
+        res = feed(prev, token)
+        if res != EosDetectorResult.EOS and engine.pos < seq_len:
+            if args.decode == "device":
+
+                def on_token(prev: int, t: int) -> bool:
+                    nonlocal res, token
+                    res = feed(prev, t)
+                    token = t
+                    return res != EosDetectorResult.EOS
+
+                # vary the stream per turn: the same base seed would replay
+                # the same draw sequence every reply
+                engine.stream_decode(
+                    token, on_token, args.temperature, args.topp,
+                    seed=sampler.seed + engine.pos, chunk=args.decode_chunk,
+                    limit=seq_len,
+                )
+            else:
+                while engine.pos < seq_len:
+                    logits = engine.decode_step(token)
+                    prev = token
+                    token = sampler.sample(logits)
+                    res = feed(prev, token)
+                    if res == EosDetectorResult.EOS:
+                        break
+        if res != EosDetectorResult.EOS:
             # context-limit exit: flush text held back as a possible
             # stop-string prefix so the reply tail is not lost
             tail = detector.flush_delta()
@@ -240,10 +302,14 @@ def worker(args) -> None:
     )
     # after initialization, every host must execute the same SPMD program
     # with identical flags (the multi-host contract: same --prompt, --steps,
-    # --tp on all hosts). Default the prompt so a bare worker participates
-    # instead of dying; the root should pass the same explicit flags here.
+    # --tp, --seed on all hosts). A missing prompt is a contract violation —
+    # a silently defaulted one would diverge from the root's program and
+    # deadlock the collectives, so fail loudly instead.
     if args.prompt is None:
-        args.prompt = "Hello world"
+        raise SystemExit(
+            "worker mode requires the SAME --prompt (and --steps/--tp/--seed) "
+            "as every other host: all hosts execute one SPMD program"
+        )
     generate(args, benchmark=False)
 
 

@@ -7,9 +7,12 @@ program with a donated KV cache, dispatched asynchronously.
 
 The headline I/T (inference/transfer ms per token) split of the reference's
 stats (src/tasks.hpp:9-11, src/apps/dllama/dllama.cpp:49-93) is preserved:
-on a single chip transfer is 0; under TP it is measured around the collective
--bearing step via profiler hooks (the collectives are fused into the program,
-so the split is reported as step time vs host-sync time).
+on a single chip transfer is 0 (no collectives exist); under TP the
+per-token collective cost is MEASURED once per engine by timing the step's
+exact collective sequence on the real mesh
+(TensorParallelForward.measure_transfer_ms) and subtracted from the step
+time — the collectives are fused inside one XLA program, so they cannot be
+timed in situ the way the reference times its TASK_TYPE_TRANSFER tasks.
 """
 
 from __future__ import annotations
@@ -94,6 +97,26 @@ class InferenceEngine:
             self._forward = functools.partial(self._forward_single, self.cfg)
         self.pos = 0
         self.stats: list[TokenStats] = []
+        self._transfer_ms: float | None = None  # measured lazily under TP
+
+    def _transfer_ms_per_token(self) -> float:
+        """Per-dispatch collective cost: 0 on a single chip; under TP measured
+        once on the real mesh (see module docstring)."""
+        if self._tp_engine is None:
+            return 0.0
+        if self._transfer_ms is None:
+            self._transfer_ms = self._tp_engine.measure_transfer_ms()
+        return self._transfer_ms
+
+    def _split_stats(self, per_entry_ms: float, n_tokens: int = 1) -> TokenStats:
+        """I/T split of one timed dispatch: the measured collective cost is an
+        upper bound (XLA overlaps collectives with compute in the real
+        program), so clamp it to the observed time — inference_ms must not go
+        negative."""
+        transfer = min(self._transfer_ms_per_token(), per_entry_ms)
+        return TokenStats(
+            per_entry_ms, per_entry_ms - transfer, transfer, n_tokens=n_tokens
+        )
 
     @staticmethod
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -137,7 +160,8 @@ class InferenceEngine:
         )
         logits = np.asarray(logits[:n])
         elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(TokenStats(elapsed, elapsed, 0.0, n_tokens=n))
+        # one program dispatch = one collective sequence, however many tokens
+        self.stats.append(self._split_stats(elapsed, n_tokens=n))
         self.pos += n
         return logits
 
@@ -162,8 +186,6 @@ class InferenceEngine:
         shard_map'd over the mesh with collectives riding every step."""
         if self.pos + n_steps > self.cfg.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {n_steps}")
-        import jax
-
         from distributed_llama_tpu.models import sampling
 
         start = time.perf_counter()
@@ -192,10 +214,33 @@ class InferenceEngine:
             )
         tokens = np.asarray(tokens)
         elapsed_ms = (time.perf_counter() - start) * 1000.0
-        per_token = elapsed_ms / n_steps
-        self.stats.extend([TokenStats(per_token, per_token, 0.0)] * n_steps)
+        self.stats.extend([self._split_stats(elapsed_ms / n_steps)] * n_steps)
         self.pos += n_steps
         return tokens
+
+    def decode_chunk(self, first_token: int, n_steps: int, temperature, topp, key):
+        """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
+        temperature/topp (no recompile when a request changes them). Returns
+        (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
+        from distributed_llama_tpu.models import sampling
+
+        start = time.perf_counter()
+        if self._tp_engine is not None:
+            tokens, self.cache, key = self._tp_engine.decode_chunk(
+                self.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
+                n_steps, temperature, topp, key,
+            )
+        else:
+            tokens, self.cache, key = sampling.decode_chunk(
+                self.cfg, self.params, jnp.int32(first_token), self.cache,
+                jnp.int32(self.pos), n_steps, jnp.float32(temperature),
+                jnp.float32(topp), key,
+            )
+        tokens = np.asarray(tokens)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        self.stats.extend([self._split_stats(elapsed_ms / n_steps)] * n_steps)
+        self.pos += n_steps
+        return tokens, key
 
     def generate_chunks(
         self,
@@ -204,31 +249,71 @@ class InferenceEngine:
         topp: float = 0.9,
         seed: int = 0,
         chunk: int = 16,
+        limit: int | None = None,
     ):
         """Generator of on-device-decoded tokens: ``chunk`` tokens per device
         dispatch (no per-token host round trip), host code between chunks.
-        ``first_token`` is consumed first, not yielded. Runs until the
-        context is exhausted — callers that stop early (EOS, stop string,
-        step budget) MUST ``rollback(pos)`` to the stream position after the
-        last token they consumed; the overshot cache slots are unreachable
-        after rollback.
+        ``first_token`` is consumed first, not yielded. One PRNG key threads
+        through the chunks and is split once per step, so the stream for a
+        given seed is identical to ``generate_on_device(seed)`` regardless of
+        chunk size.
+
+        ``limit`` stops dispatching once ``pos`` reaches it (a stop *hint*:
+        the final chunk may overshoot it — chunks keep a fixed size so XLA
+        compiles one program, not one per remaining-budget value). Callers
+        that stop consuming early (EOS, stop string, budget) MUST
+        ``rollback(pos)`` to the stream position after the last token they
+        consumed; overshot cache slots are unreachable after rollback.
 
         This is the user-facing fast path: the stepwise ``decode_step`` loop
         pays a host<->device round trip per token (the reference's regime,
         src/apps/dllama/dllama.cpp:45-59), which behind a remote PJRT tunnel
         costs more than the forward pass itself.
         """
+        key = jax.random.PRNGKey(seed)
         token = int(first_token)
-        drawn = 0
-        while self.pos < self.cfg.seq_len:
+        stop = self.cfg.seq_len if limit is None else min(limit, self.cfg.seq_len)
+        while self.pos < stop:
             k = min(chunk, self.cfg.seq_len - self.pos)
-            toks = np.asarray(
-                self.generate_on_device(token, k, temperature, topp, seed=seed + drawn)
-            )
+            toks, key = self.decode_chunk(token, k, temperature, topp, key)
             for t in toks.tolist():
                 yield int(t)
-            drawn += k
             token = int(toks[-1])
+
+    def stream_decode(
+        self,
+        first_token: int,
+        on_token,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+        chunk: int = 16,
+        limit: int | None = None,
+    ) -> int:
+        """Drive the chunked fast decode with host-side stop handling: the
+        shared consumption loop of CLI generate/chat and the API server.
+
+        ``on_token(prev_token, token) -> bool`` is called once per decoded
+        token (False = stop). This method owns the early-stop rollback
+        contract of :meth:`generate_chunks`: every decoded token counts one
+        feed of its predecessor, so on exit the stream position is rewound to
+        just after the last decoded token's feed. Returns the number of
+        decoded tokens."""
+        start_pos = self.pos
+        consumed = 0
+        prev = int(first_token)
+        for t in self.generate_chunks(
+            first_token, temperature, topp, seed=seed, chunk=chunk, limit=limit
+        ):
+            consumed += 1
+            keep_going = on_token(prev, t)
+            prev = t
+            if keep_going is False:
+                break
+            if limit is not None and start_pos + consumed >= limit:
+                break
+        self.rollback(start_pos + consumed)
+        return consumed
 
     # ------------------------------------------------------------------
     # Stats (reference: Inference::getStats, src/tasks.cpp:186-189)

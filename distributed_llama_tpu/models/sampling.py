@@ -25,10 +25,16 @@ from distributed_llama_tpu.models.config import LlamaConfig
 
 
 def sample_token(
-    logits: jax.Array, key: jax.Array, temperature: float, topp: float
+    logits: jax.Array, key: jax.Array, temperature, topp
 ) -> jax.Array:
-    """Sample one token id from f32 logits [vocab]. ``temperature``/``topp``
-    are Python floats (static under jit)."""
+    """Sample one token id from f32 logits [vocab].
+
+    ``temperature``/``topp`` may be Python floats (static under jit — the
+    greedy/top-p branches specialize away) or traced scalars (the chunked
+    decode path, where one compiled program serves every request's sampler
+    settings)."""
+    if isinstance(temperature, jax.Array) or isinstance(topp, jax.Array):
+        return _sample_token_dynamic(logits, key, temperature, topp)
     if temperature == 0.0:
         return jnp.argmax(logits).astype(jnp.int32)
     logits = logits / temperature
@@ -44,6 +50,26 @@ def sample_token(
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def _sample_token_dynamic(
+    logits: jax.Array, key: jax.Array, temperature: jax.Array, topp: jax.Array
+) -> jax.Array:
+    """Same semantics with runtime-valued temperature/topp: the greedy and
+    top-p decisions become ``jnp.where`` selects. Draw-identical to the static
+    path for the same key (the filtered-logit construction matches), so
+    chunked and single-dispatch decode produce the same stream per seed."""
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    probs = jax.nn.softmax(scaled)
+    sorted_probs = jnp.sort(probs)[::-1]
+    cum = jnp.cumsum(sorted_probs)
+    cutoff_count = jnp.sum(cum - sorted_probs < topp)
+    threshold = sorted_probs[jnp.maximum(cutoff_count - 1, 0)]
+    use_topp = (topp > 0.0) & (topp < 1.0)
+    filtered = jnp.where(use_topp & (probs < threshold), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)
+
+
 def decode_scan(
     cfg: LlamaConfig,
     params,
@@ -57,6 +83,9 @@ def decode_scan(
     axis_name: str | None = None,
 ):
     """The un-jitted decode scan body: forward → sample → feed back.
+    Returns (tokens [n_steps], cache, advanced key) — threading the returned
+    key into the next call makes the token stream independent of how the
+    decode is chunked into dispatches.
 
     With ``axis_name`` set it is the per-shard SPMD body for a shard_map'd
     tensor-parallel decode: the forward psums ride the mesh, a vocab-sharded
@@ -75,11 +104,11 @@ def decode_scan(
         nxt = sample_token(logits[0], sub, temperature, topp)
         return (nxt, cache, p + 1, k), nxt
 
-    (_, cache, _, _), tokens = jax.lax.scan(
+    (_, cache, _, key), tokens = jax.lax.scan(
         step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32), key), None,
         length=n_steps,
     )
-    return tokens, cache
+    return tokens, cache, key
 
 
 @functools.partial(
@@ -103,6 +132,29 @@ def decode_loop(
     """
     if key is None:
         key = jax.random.PRNGKey(0)
+    tokens, cache, _ = decode_scan(
+        cfg, params, first_token, cache, pos, key, n_steps, temperature, topp
+    )
+    return tokens, cache
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
+def decode_chunk(
+    cfg: LlamaConfig,
+    params,
+    first_token: jax.Array,
+    cache: jax.Array,
+    pos: jax.Array,
+    n_steps: int,
+    temperature: jax.Array,
+    topp: jax.Array,
+    key: jax.Array,
+):
+    """One chunk of the user-facing streaming decode (single chip): like
+    :func:`decode_loop` but temperature/topp are *traced* scalars — one
+    compiled program per chunk size serves every request's sampler settings —
+    and the advanced PRNG key is returned so the stream continues across
+    chunks exactly as a single dispatch would."""
     return decode_scan(
         cfg, params, first_token, cache, pos, key, n_steps, temperature, topp
     )

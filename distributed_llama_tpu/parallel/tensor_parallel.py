@@ -164,6 +164,7 @@ class TensorParallelForward:
         self.mesh = Mesh(mesh_utils.create_device_mesh((tp,), devices=devices), ("tp",))
         self.shard_vocab = cfg.vocab_size % tp == 0
         self._decode_cache: dict = {}
+        self._chunk_cache: dict = {}
         if quantized:
             self._specs = q40_param_specs(cfg, cfg.n_layers, self.shard_vocab)
         else:
@@ -237,7 +238,7 @@ class TensorParallelForward:
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), CACHE_SPEC, P(), P()),
-            out_specs=(P(), CACHE_SPEC),
+            out_specs=(P(), CACHE_SPEC, P()),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -249,7 +250,99 @@ class TensorParallelForward:
         ``n_steps`` tokens, collectives riding the mesh every step. Sampling
         runs replicated (same key → same token on every shard)."""
         jitted = self._decode_jitted(int(n_steps), float(temperature), float(topp))
-        return jitted(params, jnp.asarray(first_token), cache, jnp.asarray(pos), key)
+        tokens, cache, _ = jitted(params, jnp.asarray(first_token), cache, jnp.asarray(pos), key)
+        return tokens, cache
+
+    def _chunk_jitted(self, n_steps: int):
+        cached = self._chunk_cache.get(n_steps)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.models import sampling
+
+        cfg = self.cfg
+
+        def fn(params, first_token, cache, pos, temperature, topp, key):
+            return sampling.decode_scan(
+                cfg, params, first_token, cache, pos, key, n_steps,
+                temperature, topp, axis_name="tp",
+            )
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), CACHE_SPEC, P(), P(), P(), P()),
+            out_specs=(P(), CACHE_SPEC, P()),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._chunk_cache[n_steps] = jitted
+        return jitted
+
+    def decode_chunk(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
+        """Chunked streaming decode under TP: temperature/topp are traced
+        (one compiled program per chunk size, no per-request recompiles) and
+        the advanced PRNG key is returned for the next chunk."""
+        jitted = self._chunk_jitted(int(n_steps))
+        return jitted(
+            params, jnp.asarray(first_token), cache, jnp.asarray(pos),
+            jnp.float32(temperature), jnp.float32(topp), key,
+        )
+
+    def measure_transfer_ms(self, n_tokens: int = 32) -> float:
+        """Measure the per-token collective ("transfer") cost on this mesh.
+
+        Times a jitted program that performs exactly one decode step's
+        collective sequence per iteration — 2 psums of a [1, dim] f32
+        activation per layer (after wo and after down, the reference's two
+        gather+merge hops per layer, src/llama2-tasks.cpp:115-131/196-212)
+        plus the vocab all-gather when wcls is sharded — scanned ``n_tokens``
+        times in one dispatch. This is the TPU analogue of the reference's
+        TASK_TYPE_TRANSFER wall-time accounting (src/utils.cpp:216-218): the
+        collectives here are measured back-to-back, so the figure is an upper
+        bound on their in-program cost (XLA may overlap them with compute).
+        """
+        import time as _time
+
+        cfg = self.cfg
+        shard_vocab = self.shard_vocab
+        vshard = cfg.vocab_size // self.tp if shard_vocab else cfg.vocab_size
+
+        def token_step(carry, _):
+            x, lg = carry
+
+            def layer_step(c, _):
+                # two all-reduces per layer, as in the forward program
+                c = jax.lax.psum(c, "tp") * 0.5
+                c = jax.lax.psum(c, "tp") * 0.5
+                return c, None
+
+            x, _ = jax.lax.scan(layer_step, x, None, length=cfg.n_layers)
+            if shard_vocab:
+                g = jax.lax.all_gather(lg, "tp", axis=1, tiled=True)
+                lg = lg + jnp.sum(g) * 1e-9  # keep the gather live
+            return (x, lg), None
+
+        def fn(x, lg):
+            (x, lg), _ = jax.lax.scan(token_step, (x, lg), None, length=n_tokens)
+            return x, lg
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(None, "tp") if shard_vocab else P()),
+            out_specs=(P(), P(None, "tp") if shard_vocab else P()),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped)
+        x = jnp.ones((1, cfg.dim), jnp.float32)
+        lg = jnp.ones((1, vshard * self.tp if shard_vocab else cfg.vocab_size), jnp.float32)
+        out = jitted(x, lg)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = _time.perf_counter()
+        out = jitted(x, lg)
+        jax.block_until_ready(out)
+        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        return elapsed_ms / n_tokens
 
     def init_cache(self, dtype=jnp.float32):
         shape = (

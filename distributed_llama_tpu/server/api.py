@@ -11,7 +11,12 @@ Intentional fixes over the reference:
   but its EosDetector is constructed once with only the tokenizer stops,
   dllama-api.cpp:396-399 — request stops never reach it);
 * the delta prompt is prefilled in one batched forward instead of
-  token-by-token.
+  token-by-token;
+* decode runs on device in chunks (sampling included) instead of paying a
+  host<->device round trip per token — ``--decode host`` restores the
+  reference's stepwise regime;
+* a truncated prompt is surfaced to the caller (a ``warning`` key in the
+  response / final SSE chunk), not just printed to server stdout.
 
 Built on stdlib http.server — the reference hand-rolls HTTP on raw sockets
 (dllama-api.cpp:38-147); there is no reason to reproduce that on a host
@@ -108,11 +113,13 @@ class ApiState:
         prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
         seq_len = engine.cfg.seq_len
         budget = seq_len - engine.pos
+        warning = None
         if len(prompt_tokens) > budget:
-            print(
-                f"⚠️ prompt truncated: {len(prompt_tokens)} tokens > "
+            warning = (
+                f"prompt truncated: {len(prompt_tokens)} tokens > "
                 f"{budget} remaining context (seq_len {seq_len})"
             )
+            print(f"⚠️ {warning}")
             prompt_tokens = prompt_tokens[:budget]
         prompt_end = start_pos + len(prompt_tokens)
         for m in delta_messages:
@@ -136,11 +143,12 @@ class ApiState:
         )
 
         buffer = []
-        prev = prompt_tokens[-1]
-        pos = engine.pos
+        emitted = 0
         finish_reason = "length"  # overwritten on EOS/stop exit
-        while pos < max_pos:
-            token = self.sampler.sample(logits)
+
+        def feed(prev: int, token: int) -> EosDetectorResult:
+            nonlocal emitted
+            emitted += 1
             piece = tokenizer.decode_piece(prev, token)
             res = detector.append(token, piece if is_safe_piece(piece) else b"")
             if res in (EosDetectorResult.NOT_EOS, EosDetectorResult.EOS):
@@ -151,13 +159,48 @@ class ApiState:
                     if stream:
                         send_chunk(self._chunk_json(text, stop=False))
                 detector.clear()
-            if res == EosDetectorResult.EOS:
-                finish_reason = "stop"
-                break
-            logits = engine.decode_step(token)
-            prev = token
-            pos = engine.pos
-        else:
+            return res
+
+        # completion budget in emitted tokens (OpenAI max_tokens semantics);
+        # zero budget (prompt fills the remaining context) emits nothing
+        max_new = max_pos - prompt_end
+        res = EosDetectorResult.NOT_EOS
+        if max_new > 0:
+            token = self.sampler.sample(logits)  # first token: host sampler
+            res = feed(prompt_tokens[-1], token)
+        if res == EosDetectorResult.EOS:
+            finish_reason = "stop"
+        elif emitted < max_new and engine.pos < seq_len:
+            if getattr(self.args, "decode", "device") == "device":
+                # fast path: chunked on-device decode+sampling; temperature
+                # and top-p are runtime values (no per-request recompile)
+                seed = params["seed"]
+                if seed is None:
+                    seed = int(time.time_ns() % (1 << 31))
+
+                def on_token(prev: int, t: int) -> bool:
+                    nonlocal res, finish_reason
+                    res = feed(prev, t)
+                    if res == EosDetectorResult.EOS:
+                        finish_reason = "stop"
+                        return False
+                    return emitted < max_new
+
+                engine.stream_decode(
+                    token, on_token, params["temperature"], self.args.topp,
+                    seed=seed, chunk=getattr(self.args, "decode_chunk", 16),
+                    limit=max_pos,
+                )
+            else:
+                while emitted < max_new and engine.pos < seq_len:
+                    prev = token
+                    logits = engine.decode_step(prev)
+                    token = self.sampler.sample(logits)
+                    res = feed(prev, token)
+                    if res == EosDetectorResult.EOS:
+                        finish_reason = "stop"
+                        break
+        if finish_reason == "length":
             # length-limited exit: flush text held back as a possible stop-
             # string prefix (MAYBE_EOS) so the response tail is not lost
             tail = detector.flush_delta()
@@ -174,19 +217,20 @@ class ApiState:
             self.cache.push(engine.pos, "assistant", content)
 
         if stream:
-            send_chunk(self._chunk_json("", stop=True, finish_reason=finish_reason))
+            send_chunk(
+                self._chunk_json("", stop=True, finish_reason=finish_reason, warning=warning)
+            )
             send_chunk("[DONE]")
             return None
-        n_completion = engine.pos - prompt_end
-        return {
+        result = {
             "id": "cmpl-j0",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": MODEL_NAME,
             "usage": {
                 "prompt_tokens": len(prompt_tokens),
-                "completion_tokens": n_completion,
-                "total_tokens": len(prompt_tokens) + n_completion,
+                "completion_tokens": emitted,
+                "total_tokens": len(prompt_tokens) + emitted,
             },
             "choices": [
                 {
@@ -196,23 +240,30 @@ class ApiState:
                 }
             ],
         }
+        if warning is not None:
+            result["warning"] = warning
+        return result
 
-    def _chunk_json(self, delta_text: str, stop: bool, finish_reason: str = "stop") -> str:
+    def _chunk_json(
+        self, delta_text: str, stop: bool, finish_reason: str = "stop",
+        warning: str | None = None,
+    ) -> str:
         choice: dict = {"index": 0, "finish_reason": finish_reason if stop else ""}
         choice["delta"] = (
             {"role": "", "content": ""}
             if stop
             else {"role": "assistant", "content": delta_text}
         )
-        return json.dumps(
-            {
-                "id": "cmpl-c0",
-                "object": "chat.completion",
-                "created": int(time.time()),
-                "model": MODEL_NAME,
-                "choices": [choice],
-            }
-        )
+        payload = {
+            "id": "cmpl-c0",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": MODEL_NAME,
+            "choices": [choice],
+        }
+        if warning is not None:
+            payload["warning"] = warning
+        return json.dumps(payload)
 
     def _parse(self, body: dict) -> dict:
         # OpenAI allows stop to be a string, an array, or null

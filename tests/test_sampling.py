@@ -74,3 +74,85 @@ class TestDecodeLoop:
             assert False, "expected ValueError"
         except ValueError:
             pass
+
+
+class TestGenerateChunks:
+    """The user-facing chunked fast path (wired into CLI generate/chat and
+    the API server): stream correctness, chunk-size independence, and the
+    early-stop rollback contract."""
+
+    def _stream(self, engine, first, n, **kw):
+        out = []
+        for t in engine.generate_chunks(first, **kw):
+            out.append(t)
+            if len(out) >= n:
+                break
+        return out
+
+    def test_greedy_matches_single_dispatch(self, tmp_path):
+        spec = tiny_spec()
+        e1 = build_engine(tmp_path, spec)
+        first = int(np.argmax(e1.prefill([1, 5, 9])))
+        want = e1.generate_on_device(first, 8, temperature=0.0).tolist()
+
+        e2 = build_engine(tmp_path, spec)
+        first2 = int(np.argmax(e2.prefill([1, 5, 9])))
+        assert first2 == first
+        got = self._stream(e2, first, 8, temperature=0.0, chunk=3)
+        assert got == want
+
+    def test_seeded_stream_is_chunk_size_independent(self, tmp_path):
+        """One PRNG key threads through chunks, so temperature>0 streams are
+        identical for any chunk size AND identical to the single-dispatch
+        decode with the same seed (the round-2 advisor's reproducibility
+        complaint)."""
+        spec = tiny_spec()
+        e1 = build_engine(tmp_path, spec)
+        first = int(np.argmax(e1.prefill([2, 4])))
+        want = e1.generate_on_device(first, 9, temperature=0.9, topp=0.8, seed=13).tolist()
+
+        for chunk in (2, 4, 9):
+            e = build_engine(tmp_path, spec)
+            e.prefill([2, 4])
+            got = self._stream(
+                e, first, 9, temperature=0.9, topp=0.8, seed=13, chunk=chunk
+            )
+            assert got == want, f"chunk={chunk}"
+
+    def test_early_stop_rollback_resumes_equivalently(self, tmp_path):
+        """Stop mid-chunk, rollback, continue with decode_step: the stream
+        must equal the never-chunked stepwise stream (the cache slots beyond
+        the rollback point are overwritten, not trusted)."""
+        spec = tiny_spec()
+        ref = build_engine(tmp_path, spec)
+        token = int(np.argmax(ref.prefill([1, 5, 9])))
+        ref_stream = [token]
+        for _ in range(8):
+            token = int(np.argmax(ref.decode_step(token)))
+            ref_stream.append(token)
+
+        e = build_engine(tmp_path, spec)
+        first = int(np.argmax(e.prefill([1, 5, 9])))
+        start_pos = e.pos
+        consumed = 0
+        got = [first]
+        for t in e.generate_chunks(first, temperature=0.0, chunk=5):
+            consumed += 1
+            got.append(t)
+            if consumed == 3:  # stop mid-chunk (chunk=5)
+                break
+        e.rollback(start_pos + consumed)
+        token = got[-1]
+        for _ in range(8 - consumed):
+            token = int(np.argmax(e.decode_step(token)))
+            got.append(token)
+        assert got == ref_stream
+
+    def test_limit_stops_dispatching(self, tmp_path):
+        spec = tiny_spec(seq_len=64)
+        e = build_engine(tmp_path, spec)
+        e.prefill([1, 2, 3])
+        drawn = list(e.generate_chunks(4, temperature=0.0, chunk=4, limit=10))
+        # pos hits the limit after ceil((10-3)/4)=2 chunks of 4
+        assert len(drawn) == 8
+        assert e.pos == 11

@@ -104,3 +104,48 @@ class TestTensorParallel:
             validate_tp(cfg, 3)
         with pytest.raises(ValueError, match="n_kv_heads"):
             validate_tp(cfg, 4)
+
+
+class TestTransferMeasurement:
+    """The I/T split is measured, not hardcoded (the reference's headline
+    per-token diagnostic, src/utils.cpp:216-218)."""
+
+    def test_tp_transfer_is_measured_nonzero(self, tmp_path):
+        engine, _ = build(tmp_path, spec_8heads(), tp=4)
+        engine.prefill([1, 2, 3])
+        engine.decode_step(5)
+        avg = engine.avg_stats()
+        assert avg.transfer_ms > 0.0, "TP collectives must show as transfer time"
+        assert avg.generation_ms == pytest.approx(
+            avg.inference_ms + avg.transfer_ms, rel=1e-6
+        )
+
+    def test_single_chip_transfer_is_zero(self, tmp_path):
+        spec = spec_8heads()
+        tensors = random_tensors(spec, seed=0)
+        path = str(tmp_path / "model.m")
+        write_model_file(path, spec, tensors)
+        engine = InferenceEngine(path, dtype=jnp.float32)
+        engine.prefill([1, 2, 3])
+        engine.decode_step(5)
+        assert engine.avg_stats().transfer_ms == 0.0
+
+    def test_chunked_decode_under_tp(self, tmp_path):
+        """generate_chunks composes with TP: sharded chunk program,
+        replicated sampling, key threading."""
+        spec = spec_8heads()
+        tensors = random_tensors(spec, seed=4)
+        path = str(tmp_path / "model.m")
+        write_model_file(path, spec, tensors)
+        e1 = InferenceEngine(path, dtype=jnp.float32)
+        first = int(np.argmax(e1.prefill([1, 2, 3])))
+        want = e1.generate_on_device(first, 6, temperature=0.8, seed=3).tolist()
+
+        e4 = InferenceEngine(path, dtype=jnp.float32, tp=4)
+        e4.prefill([1, 2, 3])
+        got = []
+        for t in e4.generate_chunks(first, temperature=0.8, seed=3, chunk=2):
+            got.append(t)
+            if len(got) == 6:
+                break
+        assert got == want
