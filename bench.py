@@ -122,8 +122,9 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     if weights == "q40":
         params = random_q40_params_on_device(cfg)
     else:
-        params = random_params_on_device(cfg, dtype=jnp.bfloat16, seed=0)
-    cache = llama.init_cache(cfg, dtype=jnp.bfloat16)
+        # layered = the production per-layer-list layout (engine.weights)
+        params = random_params_on_device(cfg, dtype=jnp.bfloat16, seed=0, layered=True)
+    cache = llama.init_cache(cfg, dtype=jnp.bfloat16, layered=True)
 
     import functools
 
@@ -138,11 +139,18 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
 
     t0 = time.perf_counter()
     logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(0))
-    logits.block_until_ready()
-    prefill_ms = (time.perf_counter() - t0) * 1000.0
+    np.asarray(logits)  # host fetch: the only reliable wait on the tunneled runtime
+    prefill_ms = (time.perf_counter() - t0) * 1000.0  # COLD: includes XLA compile
+
+    # warm prefill: same shape at a later position reuses the executable —
+    # this is the steady-state serving number (round-2 verdict item #4)
+    t0 = time.perf_counter()
+    logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(prefill_len))
+    np.asarray(logits)
+    prefill_warm_ms = (time.perf_counter() - t0) * 1000.0
 
     token = jnp.int32(np.argmax(np.asarray(logits[-1])))
-    pos = prefill_len
+    pos = 2 * prefill_len
 
     # warmup: n_steps is a static argument, so the warm call must use the
     # SAME step count as the measured call or XLA compiles inside the timing
@@ -162,6 +170,27 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     elapsed = time.perf_counter() - t0
     tps = steps / elapsed
     pos += steps
+
+    # user path: the chunked streaming decode the CLI/API actually run
+    # (decode_chunk per 16 tokens, host stop-handling between dispatches)
+    from distributed_llama_tpu.models.sampling import decode_chunk
+
+    chunk = 16
+    tok_j = tokens[-1]
+    key = jax.random.PRNGKey(2)
+    toks, cache, key = decode_chunk(cfg, params, tok_j, cache, jnp.int32(pos), chunk,
+                                    jnp.float32(0.0), jnp.float32(0.9), key)  # warm/compile
+    np.asarray(toks)
+    pos += chunk
+    n_chunks = 4
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        tok_j = toks[-1]
+        toks, cache, key = decode_chunk(cfg, params, tok_j, cache, jnp.int32(pos), chunk,
+                                        jnp.float32(0.0), jnp.float32(0.9), key)
+        np.asarray(toks)  # host consumption between chunks, as the CLI does
+        pos += chunk
+    user_tps = n_chunks * chunk / (time.perf_counter() - t0)
 
     # secondary: host-sampled stepwise decode (the reference's exact regime,
     # pays a host<->device round trip per token); warm the 1-token shape first
@@ -183,8 +212,10 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
         "vs_baseline": round(tps / BASELINE_TPS, 2),
         "detail": {
             "ms_per_token": round(1000.0 / tps, 2),
+            "chunked_decode_tokens_per_sec": round(user_tps, 2),  # the CLI/API fast path
             "host_sampled_tokens_per_sec": round(host_tps, 2),
-            "prefill_ms_64_tokens": round(prefill_ms, 1),
+            "prefill_ms_64_tokens_cold": round(prefill_ms, 1),  # includes XLA compile
+            "prefill_ms_64_tokens_warm": round(prefill_warm_ms, 1),
             "baseline": "Llama 2 7B 101.81 ms/token, 1x GCP c3d-highcpu-30 (reference README.md:131)",
             "device": None,
         },

@@ -85,7 +85,7 @@ class InferenceEngine:
             from distributed_llama_tpu.parallel import tensor_parallel as tpmod
 
             self._tp_engine = tpmod.TensorParallelForward(
-                self.cfg, tp, quantized=quantized
+                self.cfg, tp, quantized=quantized, layered=True
             )
             self.params = self._tp_engine.shard_params(host_params)
             self.cache = self._tp_engine.init_cache(self.cache_dtype)
@@ -93,7 +93,9 @@ class InferenceEngine:
         else:
             self._tp_engine = None
             self.params = jax.device_put(host_params)
-            self.cache = llama.init_cache(self.cfg, dtype=self.cache_dtype)
+            # per-layer cache list matching the per-layer params list, so
+            # cache updates alias in place (see llama.init_cache)
+            self.cache = llama.init_cache(self.cfg, dtype=self.cache_dtype, layered=True)
             self._forward = functools.partial(self._forward_single, self.cfg)
         self.pos = 0
         self.stats: list[TokenStats] = []

@@ -42,8 +42,8 @@ def load_params(
     dtype applies to the matmul weights; embeddings and norm scales stay f32
     (they are F32 in the file too — reference: src/transformer.cpp:296-310).
     ``dtype="q40"`` keeps the attention/FFN/wcls matrices packed 4-bit
-    (QuantizedMatrix leaves, fed to the fused Pallas matmul); MoE expert
-    banks use bf16 until the quantized expert einsum lands.
+    (QuantizedMatrix leaves, fed to the fused Pallas matmul), including the
+    MoE expert banks (per-expert fused gate|up + down leaves).
 
     ``tp > 1`` (q40 only) builds every quantized matrix as per-shard packs in
     sharded layout: each shard's slice is READ from the file independently
@@ -157,7 +157,27 @@ def load_params(
             add("wo", weight(p + "wo"))
         add("rms_att", reader.tensor(p + "rms_att").astype(np.float32))
         add("rms_ffn", reader.tensor(p + "rms_ffn").astype(np.float32))
-        if cfg.is_moe:
+        if cfg.is_moe and quantized:
+            # per-expert fused gate|up + down QuantizedMatrix leaves: the
+            # expert banks stay 4-bit in HBM (the reference keeps experts Q40
+            # too, src/transformer.cpp:335-353) and the top-k decode path
+            # switches between per-expert kernels (models/moe.py)
+            add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
+            experts = []
+            for e in range(cfg.n_experts):
+                ep = f"{p}experts.{e}."
+                if tp > 1:
+                    experts.append({
+                        "gate_up": sharded(shard_out, [ep + "gate", ep + "up"]),
+                        "down": sharded(shard_in, ep + "down"),
+                    })
+                else:
+                    experts.append({
+                        "gate_up": weight_fused([ep + "gate", ep + "up"]),
+                        "down": weight(ep + "down"),
+                    })
+            add("experts", experts)
+        elif cfg.is_moe:
             add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
             ups, gates, downs = [], [], []
             for e in range(cfg.n_experts):
@@ -182,21 +202,15 @@ def load_params(
             add("rms_moe", reader.tensor(p + "rms_moe").astype(np.float32))
             add("rms_ffn2", reader.tensor(p + "rms_ffn2").astype(np.float32))
 
-    if quantized:
-        # q40 layers stay UNSTACKED (a list of per-layer dicts, consumed by
-        # an unrolled layer loop): stacking + per-layer slicing would make
-        # XLA hoist layout copies of every sliced Pallas operand, doubling
-        # HBM residency of the whole weight set (observed OOM on v5e)
-        n_layers = cfg.n_layers
-        layer_list = [
-            {k: vs[l] for k, vs in layers.items()} for l in range(n_layers)
-        ]
-        layers_out: Any = layer_list
-    else:
-        # stays numpy (ml_dtypes handles bf16): placement happens once, in
-        # the engine, via device_put — plain or with a NamedSharding under
-        # TP — so no full copy ever lands on a single device's HBM first
-        layers_out = {k: np.stack(vs) for k, vs in layers.items()}
+    # layers stay UNSTACKED for every dtype (a list of per-layer dicts,
+    # consumed by an unrolled layer loop). For q40, scan-slicing a stacked
+    # array would make XLA hoist layout copies of every sliced Pallas operand
+    # (observed OOM on v5e); for bf16, the lax.scan-over-stacked-layers path
+    # showed ~19 ms/token of pipeline stalls on v5e (profiled round 3) —
+    # per-layer leaves keep weight streams and cache updates alias-friendly.
+    layers_out: Any = [
+        {k: vs[l] for k, vs in layers.items()} for l in range(cfg.n_layers)
+    ]
     if quantized and tp > 1 and cfg.vocab_size % tp == 0:
         wcls = sharded(shard_out, ["wcls"])  # vocab-sharded logits head
     else:
@@ -210,32 +224,52 @@ def load_params(
     }
 
 
-def _synthetic_params(cfg: LlamaConfig, mat, ones, embedding, rope_table) -> Params:
+def _synthetic_params(
+    cfg: LlamaConfig, mat, ones, embedding, rope_table, layered: bool = False
+) -> Params:
     """Shared structure for the synthetic-param builders: the single source of
     truth for the pytree shape, kept in lockstep with load_params. ``mat``,
-    ``ones``, ``embedding`` are array factories (host numpy or on-device)."""
+    ``ones``, ``embedding`` are array factories (host numpy or on-device).
+
+    ``layered=True`` builds the production per-layer-list layout directly
+    (generating stacked then slicing would transiently double HBM on a
+    7B-scale synthetic model)."""
     D, H, K, hd = cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_size
     L, F, V = cfg.n_layers, cfg.hidden_dim, cfg.vocab_size
-    layers = {
-        "q": mat(L, D, H * hd),
-        "k": mat(L, D, K * hd),
-        "v": mat(L, D, K * hd),
-        "wo": mat(L, H * hd, D),
-        "rms_att": ones(L, D),
-        "rms_ffn": ones(L, D),
-    }
-    if cfg.is_moe:
-        E = cfg.n_experts
-        layers.update(
-            router=mat(L, D, E),
-            moe_up=mat(L, E, D, F),
-            moe_gate=mat(L, E, D, F),
-            moe_down=mat(L, E, F, D),
-        )
+
+    def layer_tree():
+        tree = {
+            "q": mat(D, H * hd),
+            "k": mat(D, K * hd),
+            "v": mat(D, K * hd),
+            "wo": mat(H * hd, D),
+            "rms_att": ones(D),
+            "rms_ffn": ones(D),
+        }
+        if cfg.is_moe:
+            E = cfg.n_experts
+            tree.update(
+                router=mat(D, E),
+                moe_up=mat(E, D, F),
+                moe_gate=mat(E, D, F),
+                moe_down=mat(E, F, D),
+            )
+        else:
+            tree.update(gate=mat(D, F), down=mat(F, D), up=mat(D, F))
+        if cfg.arch == ArchType.GROK1:
+            tree.update(rms_moe=ones(D), rms_ffn2=ones(D))
+        return tree
+
+    if layered:
+        layers: Any = [layer_tree() for _ in range(L)]
     else:
-        layers.update(gate=mat(L, D, F), down=mat(L, F, D), up=mat(L, D, F))
-    if cfg.arch == ArchType.GROK1:
-        layers.update(rms_moe=ones(L, D), rms_ffn2=ones(L, D))
+        per_layer = [layer_tree() for _ in range(L)]
+        layers = {
+            k: np.stack([pl[k] for pl in per_layer])
+            if isinstance(per_layer[0][k], np.ndarray)
+            else jnp.stack([pl[k] for pl in per_layer])
+            for k in per_layer[0]
+        }
     return {
         "embedding": embedding(V, D),
         "layers": layers,
@@ -245,7 +279,9 @@ def _synthetic_params(cfg: LlamaConfig, mat, ones, embedding, rope_table) -> Par
     }
 
 
-def random_params(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0) -> Params:
+def random_params(
+    cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0, layered: bool = False
+) -> Params:
     """Synthetic host-side params pytree with the exact structure/shapes of
     load_params. Used by tests and the multichip dry-run."""
     rng = np.random.RandomState(seed)
@@ -261,17 +297,21 @@ def random_params(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0) -> Params
     def embedding(V, D):
         return (rng.randn(V, D) * 0.02).astype(np.float32)
 
-    return _synthetic_params(cfg, mat, ones, embedding, build_rope_table(cfg))
+    return _synthetic_params(
+        cfg, mat, ones, embedding, build_rope_table(cfg), layered=layered
+    )
 
 
-def random_params_on_device(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0) -> Params:
+def random_params_on_device(
+    cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0, layered: bool = False
+) -> Params:
     """Like :func:`random_params` but generated with jax.random directly on
     the accelerator — no host RNG time and no host-to-device transfer. Used by
     the benchmark, where a 7B-parameter tree would otherwise take minutes to
     synthesize and ship."""
     import jax
 
-    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 32))
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed), 16 * cfg.n_layers + 16))
 
     def mat(*shape):
         scale = 1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
@@ -285,7 +325,9 @@ def random_params_on_device(cfg: LlamaConfig, dtype=jnp.bfloat16, seed: int = 0)
     def embedding(V, D):
         return jax.random.normal(next(keys), (V, D), dtype=jnp.float32) * 0.02
 
-    return _synthetic_params(cfg, mat, ones, embedding, jnp.asarray(build_rope_table(cfg)))
+    return _synthetic_params(
+        cfg, mat, ones, embedding, jnp.asarray(build_rope_table(cfg)), layered=layered
+    )
 
 
 def load_model(

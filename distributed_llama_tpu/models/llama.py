@@ -126,17 +126,28 @@ def attention(
     new_cache = jnp.stack([keys, values])
 
     kv_mul = Hl // Kl
-    qg = q.reshape(T, Kl, kv_mul, hd).astype(jnp.float32)
-    kf = keys.astype(jnp.float32)
-    vf = values.astype(jnp.float32)
-    scores = jnp.einsum("tkmh,skh->tkms", qg, kf, precision=jax.lax.Precision.HIGHEST) / jnp.sqrt(jnp.float32(hd))
+    # score/value einsums run with operands in the CACHE dtype and f32
+    # accumulation: casting a bf16 cache to f32 first would materialize 2x
+    # the cache bytes per layer per token (the attention reads are the
+    # second-largest HBM stream after the weights). f32 caches (parity
+    # tests) keep true-f32 multiplies via HIGHEST.
+    cdt = keys.dtype
+    prec = jax.lax.Precision.HIGHEST if cdt == jnp.float32 else None
+    qg = q.reshape(T, Kl, kv_mul, hd).astype(cdt)
+    scores = jnp.einsum(
+        "tkmh,skh->tkms", qg, keys, precision=prec,
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(jnp.float32(hd))
     # causal mask: query t (absolute pos+t) sees cache slots 0..pos+t
     t_idx = pos + jnp.arange(T)[:, None]
     s_idx = jnp.arange(S)[None, :]
     mask = s_idx <= t_idx  # [T, S]
     scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
-    att = jnp.einsum("tkms,skh->tkmh", weights, vf, precision=jax.lax.Precision.HIGHEST).reshape(T, Hl * hd)
+    att = jnp.einsum(
+        "tkms,skh->tkmh", weights.astype(cdt), values, precision=prec,
+        preferred_element_type=jnp.float32,
+    ).reshape(T, Hl * hd)
 
     out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
     if axis_name is not None:
@@ -214,12 +225,17 @@ def forward_tokens(
     if isinstance(params["layers"], (list, tuple)):
         # unrolled layer loop: used by the q40 path, whose Pallas-call
         # operands must be the resident buffers themselves (scan-slicing a
-        # stacked array makes XLA hoist a full copy of every layer's weights)
+        # stacked array makes XLA hoist a full copy of every layer's weights).
+        # The cache should be a LIST of per-layer arrays here: indexing a
+        # stacked cache and re-stacking the updates copies the ENTIRE cache
+        # every call (~1.1 GB of HBM traffic per decoded token on a 7B,
+        # ~7 ms/token of pure overhead); per-layer leaves alias in place.
+        cache_is_list = isinstance(cache, (list, tuple))
         new_layers = []
         for l, lp in enumerate(params["layers"]):
             x, nc = block_forward(cfg, x, lp, cache[l], pos, rope_rows, axis_name)
             new_layers.append(nc)
-        new_cache = jnp.stack(new_layers)
+        new_cache = new_layers if cache_is_list else jnp.stack(new_layers)
     else:
 
         def body(carry, scanned):
@@ -238,9 +254,19 @@ def forward_tokens(
 
 
 def init_cache(
-    cfg: LlamaConfig, n_kv_heads_local: int | None = None, dtype=jnp.float32
-) -> jax.Array:
+    cfg: LlamaConfig,
+    n_kv_heads_local: int | None = None,
+    dtype=jnp.float32,
+    layered: bool = False,
+) -> jax.Array | list[jax.Array]:
     """Preallocated KV cache [L, 2, S, Kl, hd]
-    (reference: KvCacheSlice, src/commands.cpp:97-102)."""
+    (reference: KvCacheSlice, src/commands.cpp:97-102).
+
+    ``layered=True`` returns a list of per-layer [2, S, Kl, hd] arrays — the
+    form the unrolled (q40) forward needs so in-place cache updates alias
+    instead of copying the whole cache each step (see forward_tokens)."""
     kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
-    return jnp.zeros((cfg.n_layers, 2, cfg.seq_len, kl, cfg.head_size), dtype=dtype)
+    shape = (2, cfg.seq_len, kl, cfg.head_size)
+    if layered:
+        return [jnp.zeros(shape, dtype=dtype) for _ in range(cfg.n_layers)]
+    return jnp.zeros((cfg.n_layers,) + shape, dtype=dtype)

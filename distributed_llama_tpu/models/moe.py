@@ -12,10 +12,16 @@ TPU-first design notes:
 * Experts are TP-sliced exactly like the reference (every shard holds a
   1/n-of-hidden slice of *all* experts — transformer.cpp:335-353), so the
   expert weighted-sum needs the same single psum as the dense FFN.
-* Expert mixing is dense one-hot (every expert computed, weighted by a
-  mostly-zero [T, E] matrix). For the single-token decode path this trades
-  (E/k)× MXU flops for zero dynamic gathers; a top-k gathered variant is the
-  planned Pallas optimization (SURVEY.md §7 stage 5).
+* Decode (T == 1) computes ONLY the top-k experts: each selected expert runs
+  under a `lax.lax.switch` whose branches close over one expert's weights, so
+  HBM reads and MXU flops scale with k, not E (top-2-of-8 Mixtral decode
+  touches 4x less expert memory than dense mixing). Prefill (T > 1) keeps
+  dense one-hot mixing: tokens fan out across experts anyway and the batched
+  einsum keeps the MXU fed without per-token gathers.
+* Expert banks may be Q40: `engine.weights` loads each expert as fused
+  gate|up + down `QuantizedMatrix` leaves (an ``experts`` list in the layer
+  params), so a Q40 Mixtral file occupies ~file-size HBM instead of
+  inflating 4x to bf16.
 """
 
 from __future__ import annotations
@@ -27,30 +33,82 @@ from distributed_llama_tpu.formats.model_file import ArchType
 from distributed_llama_tpu.models.config import LlamaConfig
 
 
-def router_weights(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Array:
-    """[T, E] mixing weights: softmax over all experts, top-k selected, the
-    selected weights renormalized to sum to 1 (reference:
-    src/grok1-tasks.cpp:62-114)."""
+def router_probs(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Array:
+    """[T, E] softmax router probabilities (reference: src/grok1-tasks.cpp:62-97)."""
     logits = jnp.einsum(
         "td,de->te",
         xn.astype(jnp.float32),
         router.astype(jnp.float32),
         precision=jax.lax.Precision.HIGHEST,
     )
-    probs = jax.nn.softmax(logits, axis=-1)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def router_weights(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Array:
+    """[T, E] mixing weights: top-k selected, renormalized to sum to 1,
+    zero elsewhere (reference: src/grok1-tasks.cpp:62-114)."""
+    probs = router_probs(cfg, xn, router)
     top_vals, top_idx = jax.lax.top_k(probs, cfg.n_active_experts)
     top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
     one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32)  # [T, k, E]
     return jnp.einsum("tk,tke->te", top_vals, one_hot)
 
 
-def moe_ffn(cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None) -> jax.Array:
-    """Expert-mixed SwiGLU. ``xn``: [T, dim] (already normed);
-    lp["moe_up"/"moe_gate"]: [E, dim, hidden_local], lp["moe_down"]:
-    [E, hidden_local, dim]; returns [T, dim] (psum'd over TP shards)."""
-    from distributed_llama_tpu.models.llama import _activation  # no cycle at call time
+def _expert_weights(lp, e: int):
+    """Weights of expert ``e``: a dict with either fused ``gate_up`` (+
+    ``down``) QuantizedMatrix leaves (the q40 layout) or separate
+    ``gate``/``up``/``down`` slices of the stacked bf16 banks."""
+    if "experts" in lp:
+        return lp["experts"][e]
+    return {"gate": lp["moe_gate"][e], "up": lp["moe_up"][e], "down": lp["moe_down"][e]}
 
+
+def _expert_ffn(cfg: LlamaConfig, xn: jax.Array, ew) -> jax.Array:
+    """One expert's SwiGLU on normed input [T, D] -> [T, D] f32 (pre-psum,
+    pre-weighting). Mirrors the dense FFN's fused-vs-separate dispatch."""
+    from distributed_llama_tpu.models.llama import _activation, _matmul
+
+    if "gate_up" in ew:
+        fused = _matmul(xn.astype(ew["gate_up"].dtype), ew["gate_up"])
+        hidden = fused.shape[-1] // 2
+        h = _activation(fused[:, :hidden], cfg.hidden_act) * fused[:, hidden:]
+    else:
+        xc = xn.astype(ew["gate"].dtype)
+        h = _activation(_matmul(xc, ew["gate"]), cfg.hidden_act) * _matmul(xc, ew["up"])
+    return _matmul(h.astype(ew["down"].dtype), ew["down"])
+
+
+def _moe_topk(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
+    """Decode path: run exactly the k selected experts via lax.switch.
+    Routing is replicated across shards (same input -> same indexes), the
+    reference's index broadcast with the broadcast removed."""
+    probs = router_probs(cfg, xn, lp["router"])  # [1, E]
+    top_vals, top_idx = jax.lax.top_k(probs[0], cfg.n_active_experts)
+    top_vals = top_vals / jnp.sum(top_vals)
+    branches = [
+        (lambda x_, e=e: _expert_ffn(cfg, x_, _expert_weights(lp, e)))
+        for e in range(cfg.n_experts)
+    ]
+    out = jnp.zeros(xn.shape, jnp.float32)
+    for i in range(cfg.n_active_experts):
+        out = out + top_vals[i] * jax.lax.switch(top_idx[i], branches, xn)
+    return out
+
+
+def _moe_dense(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
+    """Prefill path: every expert computed, mixed by the mostly-zero [T, E]
+    weight matrix. For stacked bf16 banks this is one batched einsum; for
+    per-expert q40 leaves it is E fused-kernel calls."""
     weights = router_weights(cfg, xn, lp["router"])  # [T, E] f32
+    if "experts" in lp:
+        out = jnp.zeros(xn.shape, jnp.float32)
+        for e in range(cfg.n_experts):
+            out = out + weights[:, e : e + 1] * _expert_ffn(
+                cfg, xn, _expert_weights(lp, e)
+            )
+        return out
+    from distributed_llama_tpu.models.llama import _activation
+
     xc = xn.astype(lp["moe_up"].dtype)
     gate = jnp.einsum(
         "td,edh->teh", xc, lp["moe_gate"], preferred_element_type=jnp.float32,
@@ -65,7 +123,16 @@ def moe_ffn(cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None) -> jax.A
         "teh,ehd->ted", h.astype(lp["moe_down"].dtype), lp["moe_down"],
         preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST,
     )
-    out = jnp.einsum("te,ted->td", weights, down, precision=jax.lax.Precision.HIGHEST)
+    return jnp.einsum("te,ted->td", weights, down, precision=jax.lax.Precision.HIGHEST)
+
+
+def moe_ffn(cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None) -> jax.Array:
+    """Expert-mixed SwiGLU. ``xn``: [T, dim] (already normed); returns
+    [T, dim] (psum'd over TP shards)."""
+    if xn.shape[0] == 1:
+        out = _moe_topk(cfg, xn, lp)
+    else:
+        out = _moe_dense(cfg, xn, lp)
     if axis_name is not None:
         out = jax.lax.psum(out, axis_name)
     return out

@@ -35,14 +35,30 @@ def _try_build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """The built library is older than a source file (e.g. a checkout built
+    before an ABI change): calling through a new prototype into an old
+    binary corrupts memory, so rebuild first."""
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        return any(
+            os.path.getmtime(os.path.join(_LIB_DIR, f)) > lib_mtime
+            for f in os.listdir(_LIB_DIR)
+            if f.endswith((".cpp", ".h"))
+        )
+    except OSError:
+        return True
+
+
 def load_library(build: bool = True):
     """Returns the loaded library or None. Builds it on first use if a
-    toolchain is available."""
+    toolchain is available (and rebuilds when sources are newer than the
+    binary — the C ABI may have changed)."""
     global _lib, _load_attempted
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
-    if not os.path.exists(_LIB_PATH) and build:
+    if (not os.path.exists(_LIB_PATH) or _stale()) and build:
         if not _try_build():
             return None
     try:
@@ -54,7 +70,7 @@ def load_library(build: bool = True):
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
     ]
     lib.q40_repack_tpu.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.bpe_new.restype = ctypes.c_void_p
@@ -92,17 +108,21 @@ def q40_dequant_f32(blocks: np.ndarray, n_values: int) -> np.ndarray | None:
     return out
 
 
-def q40_repack_tpu(blocks: np.ndarray, d_out: int, d_in: int):
-    """Repack raw Q40 file bytes to (packed [d_in/2, d_out] uint8,
-    scales [d_in/32, d_out] f32); None if lib missing."""
+def q40_repack_tpu(blocks: np.ndarray, d_out: int, d_in: int, n_pad: int):
+    """Repack raw Q40 file bytes to the half-split layout: (packed
+    [n_pad/2, d_out] uint8, scales [n_pad/32, d_out] f32 with zero-scale
+    padding rows); None if lib missing. ``n_pad`` is the caller's padded
+    input dim (ops.q40._n_padded — the padding rule lives there, once)."""
     lib = load_library()
     if lib is None:
         return None
+    if n_pad % 64 or n_pad < d_in:
+        raise ValueError(f"n_pad {n_pad} must be a 64-multiple >= d_in {d_in}")
     blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
-    packed = np.zeros((d_in // 2, d_out), np.uint8)  # OR-accumulated
-    scales = np.empty((d_in // 32, d_out), np.float32)
+    packed = np.zeros((n_pad // 2, d_out), np.uint8)  # OR-accumulated
+    scales = np.zeros((n_pad // 32, d_out), np.float32)  # padding rows stay 0
     lib.q40_repack_tpu(
-        blocks.ctypes.data, d_out, d_in, packed.ctypes.data, scales.ctypes.data
+        blocks.ctypes.data, d_out, d_in, n_pad, packed.ctypes.data, scales.ctypes.data
     )
     return packed, scales
 

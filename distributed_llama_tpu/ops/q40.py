@@ -7,15 +7,21 @@ memory bus can feed the cores"; the TPU version is the same story at HBM
 scale: a bf16 7B model is ~13.5 GB of HBM traffic per decoded token, the Q40
 form is ~4.2 GB, so the bandwidth-bound decode roofline rises ~3×.
 
-Layout (``pack_q40_tpu``): for a matmul ``y[T,d] = x[T,n] @ W[n,d]``
-  * ``qs``     uint8 [n/2, d] — W[2i,j] in the low nibble, W[2i+1,j] in the
-               high nibble, values biased by +8 (the file format's bias,
-               reference: src/quants.cpp:171-182)
-  * ``scales`` f32 [n/32, d] — per-(32-input-block, output-column) scale
+Layout (``pack_q40_tpu``): for a matmul ``y[T,d] = x[T,n] @ W[n,d]``, with
+n padded to ``n_pad`` (zero-scale rows) and ``half = n_pad/2``:
+  * ``qs``     uint8 [n_pad/2, d] — W[i,j] in the low nibble and
+               W[i+half,j] in the high nibble ("half-split" pairing),
+               values biased by +8 (the file format's bias, reference:
+               src/quants.cpp:171-182)
+  * ``scales`` f32 [n_pad/32, d] — per-(32-input-block, output-column) scale
 
 The repack from the file's row-major block form is *exact*: nibbles are
-reordered, never re-quantized. Unpacking in-kernel is two masks and a
-sub; the dequantized tile feeds ``jnp.dot`` with f32 accumulation.
+reordered, never re-quantized. Half-split pairing is what makes the matmul
+gather-free: the kernel contracts the low nibbles against x[:, :half] and
+the high nibbles against x[:, half:] — two CONTIGUOUS windows of x (a
+matmul contraction is permutation-invariant when both operands are permuted
+alike). The previous even/odd-row pairing needed strided x[:, 0::2] splits,
+which XLA lowers to gathers costing ~6 ms/token on a 7B decode.
 
 On non-TPU backends (tests) the kernel runs in Pallas interpret mode.
 """
@@ -33,13 +39,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from distributed_llama_tpu.quants import QK
 
-# Tile sizes tuned on v5e (slope-timed to exclude the remote tunnel's fixed
-# dispatch cost): with the split-x kernel, (1024, 1024) runs a 4096x11008
-# T=1 matvec at ~300 GB/s of packed bytes vs ~45 GB/s for the old
-# interleaving kernel. Small divisor tiles (256x256) are ~10x slower — the
-# per-grid-step overhead dominates.
-BLOCK_N = 1024  # input-dim tile (must be a multiple of 32)
-BLOCK_D = 1024  # output-dim tile (must be a multiple of 128)
+# Tile sizes tuned on v5e (profiled in-model on real decode programs):
+# (1024, 1024) runs the kernel at ~375 GB/s of packed bytes in a 7B decode;
+# small divisor tiles (256x256) are ~10x slower — per-grid-step overhead
+# dominates. Env overrides exist for tuning on other chip generations.
+import os as _os
+
+BLOCK_N = int(_os.environ.get("DLT_BN", 1024))  # input tile (MULTIPLE OF 512:
+# the x window needs bn/2 % 128 == 0 and the scales tile bn/64 % 8 == 0 —
+# smaller values silently push every matmul onto the slow XLA fallback)
+BLOCK_D = int(_os.environ.get("DLT_BD", 1024))  # output tile (multiple of 128)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -90,20 +99,35 @@ class QuantizedMatrix:
         return cls(*children, *aux)
 
 
-def _pad_packed(packed: np.ndarray, scales: np.ndarray, n: int, d: int,
-                n_mult: int = 512, d_mult: int = 1024) -> QuantizedMatrix:
-    """Zero-scale padding up to tile multiples. Padded regions contribute
-    exact zeros to the matmul (scale 0), so no output slicing is needed for
-    chained layers — only logits consumers must trim to d_logical."""
-    # only pad dims that exceed the tile target — small matrices take small
-    # tiles (or the XLA fallback) without a padding blow-up
-    n_pad = -(-n // n_mult) * n_mult if n > n_mult else n
-    d_pad = -(-d // d_mult) * d_mult if d > d_mult else d
+def _n_padded(n: int) -> int:
+    """Padded input dim: 512-multiples for kernel-eligible matrices (the
+    scales-tile sublane rule needs block_n % 512 == 0), 64-multiples below
+    that (half-split block alignment; such matrices take the XLA fallback)."""
+    m = 512 if n > 512 else 64
+    return -(-n // m) * m
+
+
+def _d_padded(d: int) -> int:
+    """Padded output dim: only pad dims that exceed the tile target — small
+    matrices take small tiles (or the XLA fallback) without a blow-up."""
+    return -(-d // 1024) * 1024 if d > 1024 else d
+
+
+def _pack_halves(vals_t: np.ndarray, scales_t: np.ndarray, n: int, d: int) -> QuantizedMatrix:
+    """Pack BIASED nibble values [n, d] into the half-split layout after
+    zero-scale padding. Padded regions contribute exact zeros to the matmul
+    (scale 0), so no output slicing is needed for chained layers — only
+    logits consumers must trim to d_logical."""
+    n_pad, d_pad = _n_padded(n), _d_padded(d)
     if n_pad != n or d_pad != d:
-        packed = np.pad(packed, ((0, (n_pad - n) // 2), (0, d_pad - d)))
-        scales = np.pad(scales, ((0, (n_pad - n) // 32), (0, d_pad - d)))
+        vals_t = np.pad(vals_t, ((0, n_pad - n), (0, d_pad - d)))
+        scales_t = np.pad(
+            scales_t, ((0, n_pad // 32 - scales_t.shape[0]), (0, d_pad - d))
+        )
+    half = n_pad // 2
+    packed = (vals_t[:half] | (vals_t[half:] << 4)).astype(np.uint8)
     return QuantizedMatrix(
-        qs=jnp.asarray(packed), scales=jnp.asarray(scales),
+        qs=jnp.asarray(packed), scales=jnp.asarray(scales_t),
         n_logical=n, d_logical=d,
     )
 
@@ -119,8 +143,6 @@ def pack_q40_tpu(file_qs: np.ndarray, file_scales: np.ndarray, shape: tuple[int,
     d_out, d_in = shape
     if d_in % QK:
         raise ValueError(f"d_in {d_in} not divisible by {QK}")
-    if d_out % 2:
-        raise ValueError(f"d_out {d_out} must be even for nibble pairing")
     blocks_per_row = d_in // QK
 
     try:  # native repack (native/q40_native.cpp) — same output, much faster
@@ -131,10 +153,9 @@ def pack_q40_tpu(file_qs: np.ndarray, file_scales: np.ndarray, shape: tuple[int,
             np.ascontiguousarray(file_scales).astype(np.float16).view(np.uint8).reshape(-1, 2)
         )
         raw[:, 2:] = np.asarray(file_qs).reshape(-1, QK // 2)
-        fast = native.q40_repack_tpu(raw.reshape(-1), d_out, d_in)
+        fast = _pack_raw_native(native, raw.reshape(-1), d_out, d_in)
         if fast is not None:
-            packed_n, scales_n = fast
-            return _pad_packed(packed_n, scales_n, d_in, d_out)
+            return fast
     except Exception:
         pass
     qs = file_qs.reshape(d_out, blocks_per_row, QK // 2)
@@ -144,10 +165,27 @@ def pack_q40_tpu(file_qs: np.ndarray, file_scales: np.ndarray, shape: tuple[int,
     hi = qs >> 4
     vals = np.concatenate([lo, hi], axis=-1).reshape(d_out, d_in)  # uint8 biased
     scales = file_scales.reshape(d_out, blocks_per_row).astype(np.float32)
+    return _pack_halves(
+        np.ascontiguousarray(vals.T), np.ascontiguousarray(scales.T), d_in, d_out
+    )
 
-    vals_t = vals.T  # [d_in, d_out]
-    packed = (vals_t[0::2] | (vals_t[1::2] << 4)).astype(np.uint8)  # [d_in/2, d_out]
-    return _pad_packed(packed, np.ascontiguousarray(scales.T), d_in, d_out)
+
+def _pack_raw_native(native, raw: np.ndarray, d_out: int, d_in: int):
+    """Native half-split repack: the C++ side writes directly into the
+    padded packed/scales arrays (padding rows are zero-scale)."""
+    n_pad = _n_padded(d_in)
+    out = native.q40_repack_tpu(raw, d_out, d_in, n_pad)
+    if out is None:
+        return None
+    packed, scales = out
+    d_pad = _d_padded(d_out)
+    if d_pad != d_out:
+        packed = np.pad(packed, ((0, 0), (0, d_pad - d_out)))
+        scales = np.pad(scales, ((0, 0), (0, d_pad - d_out)))
+    return QuantizedMatrix(
+        qs=jnp.asarray(packed), scales=jnp.asarray(scales),
+        n_logical=d_in, d_logical=d_out,
+    )
 
 
 def pack_q40_raw(raw: np.ndarray | bytes, shape: tuple[int, int]) -> QuantizedMatrix:
@@ -157,10 +195,9 @@ def pack_q40_raw(raw: np.ndarray | bytes, shape: tuple[int, int]) -> QuantizedMa
     try:
         from distributed_llama_tpu import native
 
-        fast = native.q40_repack_tpu(np.frombuffer(raw, np.uint8), d_out, d_in)
+        fast = _pack_raw_native(native, np.frombuffer(raw, np.uint8), d_out, d_in)
         if fast is not None:
-            packed, scales = fast
-            return _pad_packed(packed, scales, d_in, d_out)
+            return fast
     except Exception:
         pass
     from distributed_llama_tpu.quants import q40_from_bytes
@@ -172,21 +209,15 @@ def pack_q40_raw(raw: np.ndarray | bytes, shape: tuple[int, int]) -> QuantizedMa
 def quantize_q40_tpu(w: np.ndarray) -> QuantizedMatrix:
     """Quantize a float matrix W [n, d] (already in x@W orientation) directly
     to the TPU layout. Quantization blocks run along the input dim n,
-    mirroring the file format's along-row blocks after transpose. An odd
-    output dim is zero-padded to even (nibble pairing needs row pairs)."""
+    mirroring the file format's along-row blocks after transpose (half-split
+    pairing is on input rows, so d has no parity constraint)."""
     from distributed_llama_tpu.quants import quantize_q40
 
     n, d = w.shape
-    d_even = d + (d % 2)
-    if d_even != d:
-        w = np.pad(w, ((0, 0), (0, 1)))
     qs_file, scales_file = quantize_q40(np.ascontiguousarray(w.T))  # blocks along n
-    qm = pack_q40_tpu(
-        qs_file.reshape(-1, QK // 2), scales_file.reshape(-1), (d_even, n)
+    return pack_q40_tpu(
+        qs_file.reshape(-1, QK // 2), scales_file.reshape(-1), (d, n)
     )
-    if d_even != d:
-        qm = QuantizedMatrix(qm.qs, qm.scales, n_logical=qm.n, d_logical=d)
-    return qm
 
 
 def concat_shard_packs(mats: list[QuantizedMatrix], axis: str) -> QuantizedMatrix:
@@ -217,10 +248,10 @@ def dequantize_tpu(qm: QuantizedMatrix) -> np.ndarray:
     Trims any tile padding back to the logical dims."""
     qs = np.asarray(qm.qs)
     scales = np.asarray(qm.scales)
-    n2, d = qs.shape
-    vals = np.empty((n2 * 2, d), np.int8)
-    vals[0::2] = (qs & 0xF).astype(np.int8) - 8
-    vals[1::2] = (qs >> 4).astype(np.int8) - 8
+    # half-split: low nibbles are logical rows [0, half), high [half, n_pad)
+    lo = (qs & 0xF).astype(np.int8) - 8
+    hi = (qs >> 4).astype(np.int8) - 8
+    vals = np.concatenate([lo, hi], axis=0)
     scale_full = np.repeat(scales, QK, axis=0)
     return (vals.astype(np.float32) * scale_full)[: qm.n, : qm.d]
 
@@ -229,18 +260,16 @@ def _make_q40_kernel(compute_dtype):
     """Kernel factory: one (d-tile, n-tile) grid step dequantizes the weight
     tile in VMEM and accumulates into the f32 accumulator.
 
-    The packed tile's low nibbles are even input rows, high nibbles odd rows.
-    Instead of interleaving them back to natural order (a sublane relayout
-    that dominated the old kernel's runtime, ~6x slower), the caller splits x
-    into even/odd columns once outside and the kernel runs two half-size dots
-    — a matmul's contraction is permutation-invariant when both operands are
-    permuted alike.
+    Half-split pairing: the packed tile's low nibbles are logical rows
+    [j*bn/2, (j+1)*bn/2) and the high nibbles rows half + the same window,
+    so the two dots contract against two CONTIGUOUS windows of x delivered
+    as separate BlockSpec views — no strided splits, no relayouts anywhere.
 
     ``compute_dtype`` is bf16 on TPU (Q40's quantization noise dwarfs bf16
     round-off, and bf16 halves VMEM footprint and VPU work) and f32 in
     interpret mode (XLA:CPU cannot execute bf16 x bf16 dots)."""
 
-    def kernel(xe_ref, xo_ref, qs_ref, scales_ref, out_ref, acc_ref):
+    def kernel(xlo_ref, xhi_ref, qs_ref, slo_ref, shi_ref, out_ref, acc_ref):
         j = pl.program_id(1)
 
         @pl.when(j == 0)
@@ -248,18 +277,20 @@ def _make_q40_kernel(compute_dtype):
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
         qs = qs_ref[:].astype(jnp.int32)  # [bn/2, bd]; mosaic has no u8->f32 cast
-        lo = (qs & 0xF).astype(compute_dtype) - 8.0
+        # nibbles stay BIASED (0..15): the -8 would cost two more full-size
+        # VPU passes here; the caller subtracts 8*sum(x_block)@scales computed
+        # on the MXU instead (see q40_matmul)
+        lo = (qs & 0xF).astype(compute_dtype)
         # qs holds u8 values, so >>4 is already in 0..15 — no mask needed
         # (dropping the redundant & 0xF is worth ~25% on the VPU-bound unpack)
-        hi = (qs >> 4).astype(compute_dtype) - 8.0
-        s = scales_ref[:].astype(compute_dtype)  # [bn/32, bd]
+        hi = (qs >> 4).astype(compute_dtype)
         bn2, bd = qs.shape
-        # packed row i = logical rows (2i, 2i+1), both in 32-block i//16: the
-        # scale row broadcasts over 16 packed rows for lo and hi alike
-        wlo = (lo.reshape(-1, 16, bd) * s[:, None, :]).reshape(bn2, bd)
-        whi = (hi.reshape(-1, 16, bd) * s[:, None, :]).reshape(bn2, bd)
-        acc_ref[:] += jnp.dot(xe_ref[:], wlo, preferred_element_type=jnp.float32)
-        acc_ref[:] += jnp.dot(xo_ref[:], whi, preferred_element_type=jnp.float32)
+        # lo/hi rows are CONSECUTIVE logical rows: each scale row broadcasts
+        # over its 32-row block
+        wlo = (lo.reshape(-1, QK, bd) * slo_ref[:].astype(compute_dtype)[:, None, :]).reshape(bn2, bd)
+        whi = (hi.reshape(-1, QK, bd) * shi_ref[:].astype(compute_dtype)[:, None, :]).reshape(bn2, bd)
+        acc_ref[:] += jnp.dot(xlo_ref[:], wlo, preferred_element_type=jnp.float32)
+        acc_ref[:] += jnp.dot(xhi_ref[:], whi, preferred_element_type=jnp.float32)
 
         @pl.when(j == pl.num_programs(1) - 1)
         def _():
@@ -289,8 +320,11 @@ def q40_matmul(
         block_d = min(block_d, 512)
     if T > 256:
         block_d = min(block_d, 256)
-    # tiles must divide the (padded) dims
-    block_n = _largest_divisor_tile(np_, block_n, 32)
+    # tiles must divide the (padded) dims; block_n granule 512: the x window
+    # (T, bn/2) needs bn/2 % 128 == 0 and the scales tile (bn/64, bd) needs
+    # bn/64 % 8 == 0 (mosaic sublane/lane tiling rules) — smaller matrices
+    # take the XLA fallback
+    block_n = _largest_divisor_tile(np_, block_n, 512)
     block_d = _largest_divisor_tile(dp, block_d, 128)
     if block_n is None or block_d is None:
         return _q40_matmul_fallback(x, qm)
@@ -303,17 +337,20 @@ def q40_matmul(
         x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
     compute_dtype = jnp.float32 if interpret else jnp.bfloat16
     xb = x.astype(compute_dtype)
-    xe = xb[:, 0::2]  # pairs with the low nibbles (logical rows 2i)
-    xo = xb[:, 1::2]  # pairs with the high nibbles (logical rows 2i+1)
-    grid = (dp // block_d, np_ // block_n)
+    nj = np_ // block_n
+    grid = (dp // block_d, nj)
+    # x is NOT split on the host: the lo/hi halves arrive as two BlockSpec
+    # views over the same array — window j for the low nibbles, window
+    # nj + j (the upper half) for the high nibbles. Contiguous, gather-free.
     out = pl.pallas_call(
         _make_q40_kernel(compute_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
-            pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
+            pl.BlockSpec((T, block_n // 2), lambda i, j, nj=nj: (0, nj + j)),
             pl.BlockSpec((block_n // 2, block_d), lambda i, j: (j, i)),
-            pl.BlockSpec((block_n // QK, block_d), lambda i, j: (j, i)),
+            pl.BlockSpec((block_n // 2 // QK, block_d), lambda i, j: (j, i)),
+            pl.BlockSpec((block_n // 2 // QK, block_d), lambda i, j, nj=nj: (nj + j, i)),
         ],
         out_specs=pl.BlockSpec((T, block_d), lambda i, j: (0, i)),
         out_shape=jax.ShapeDtypeStruct((T, dp), jnp.float32),
@@ -322,7 +359,24 @@ def q40_matmul(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(xe, xo, qm.qs, qm.scales)
+    )(xb, xb, qm.qs, qm.scales, qm.scales)
+    # the kernel dequantized BIASED nibbles (0..15); subtract the +8 bias as
+    # a rank-reduced correction on the MXU instead of 2 VPU passes over every
+    # weight element: sum(x per 32-block) @ scales = sum_i x_i * s_b(i),d.
+    # The sum MUST accumulate in f32: the correction is ~5x the output
+    # magnitude, so bf16 accumulation error here would dominate the result
+    # (measured 6x accuracy loss) — f32 makes it the exact sum of the same
+    # bf16 x values the kernel consumed.
+    xsum = jnp.sum(xb.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
+    corr = jax.lax.dot_general(
+        xsum, qm.scales,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        # true-f32 multiplies: the correction cancels against a 5x-larger
+        # kernel sum, so TPU's default bf16 demotion would leak error; the
+        # dot is rank-n/32 — 3-pass f32 costs nothing measurable
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out = out - 8.0 * corr
     return out[:, :d] if dp != d else out
 
 
@@ -342,7 +396,8 @@ def _q40_matmul_fallback(x: jax.Array, qm: QuantizedMatrix) -> jax.Array:
     np_, dp = qm.n_padded, qm.d_padded
     lo = (qm.qs & 0xF).astype(jnp.int8) - 8
     hi = (qm.qs >> 4).astype(jnp.int8) - 8
-    w_int = jnp.stack([lo, hi], axis=-2).reshape(np_, dp)
+    # half-split: low nibbles are rows [0, half), high [half, n_pad)
+    w_int = jnp.concatenate([lo, hi], axis=-2)
     w = w_int.astype(jnp.float32).reshape(-1, QK, dp) * qm.scales[..., None, :]
     w = w.reshape(np_, dp)
     if x.shape[-1] != np_:

@@ -107,6 +107,20 @@ def param_specs(cfg: LlamaConfig, shard_vocab: bool) -> dict[str, Any]:
     }
 
 
+def param_specs_layered(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[str, Any]:
+    """Specs for the per-layer-list params layout (engine.weights.load_params):
+    each layer's specs are the stacked specs with the leading layer axis
+    stripped."""
+    single = {k: P(*s[1:]) for k, s in layer_param_specs(cfg).items()}
+    return {
+        "embedding": P(None, None),
+        "layers": [dict(single) for _ in range(n_layers)],
+        "rms_final": P(None),
+        "wcls": P(None, "tp") if shard_vocab else P(None, None),
+        "rope_table": P(None, None, None),
+    }
+
+
 def q40_layer_specs(cfg: LlamaConfig) -> dict[str, P]:
     """PartitionSpecs for ONE layer of the q40 per-layer-list layout
     (fused qkv/gate_up, QuantizedMatrix leaves — a spec here is a pytree
@@ -120,9 +134,13 @@ def q40_layer_specs(cfg: LlamaConfig) -> dict[str, P]:
     if cfg.is_moe:
         specs.update(
             router=P(None, None),
-            moe_up=P(None, None, "tp"),  # [E, D, Hl] bf16 expert banks
-            moe_gate=P(None, None, "tp"),
-            moe_down=P(None, "tp", None),
+            # per-expert q40 leaves (engine.weights): each expert's fused
+            # gate|up is output-sharded, its down input-sharded, like the
+            # dense FFN
+            experts=[
+                {"gate_up": P(None, "tp"), "down": P("tp", None)}
+                for _ in range(cfg.n_experts)
+            ],
         )
     else:
         specs.update(gate_up=P(None, "tp"), down=P("tp", None))
@@ -142,6 +160,7 @@ def q40_param_specs(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[
 
 
 CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
+CACHE_SPEC_LAYER = P(None, None, "tp", None)  # [2, S, K, hd] (q40 layered cache)
 
 
 class TensorParallelForward:
@@ -152,11 +171,22 @@ class TensorParallelForward:
     ``engine.weights.load_params(tp=...)``).
     """
 
-    def __init__(self, cfg: LlamaConfig, tp: int, devices=None, quantized: bool = False):
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        tp: int,
+        devices=None,
+        quantized: bool = False,
+        layered: bool | None = None,
+    ):
         validate_tp(cfg, tp, quantized=quantized)
         self.cfg = cfg
         self.tp = tp
         self.quantized = quantized
+        # layered = per-layer-list params + cache (the engine's production
+        # layout for every dtype); stacked remains for synthetic-params
+        # callers (tests, the driver dryrun)
+        self.layered = quantized if layered is None else layered
         if devices is None:
             devices = jax.devices()[:tp]
         if len(devices) < tp:
@@ -167,15 +197,23 @@ class TensorParallelForward:
         self._chunk_cache: dict = {}
         if quantized:
             self._specs = q40_param_specs(cfg, cfg.n_layers, self.shard_vocab)
+        elif self.layered:
+            self._specs = param_specs_layered(cfg, cfg.n_layers, self.shard_vocab)
         else:
             self._specs = param_specs(cfg, self.shard_vocab)
+        if self.layered:
+            # layered cache (list of per-layer arrays): the unrolled forward
+            # needs per-leaf in-place aliasing (see llama.init_cache)
+            self._cache_spec: Any = [CACHE_SPEC_LAYER] * cfg.n_layers
+        else:
+            self._cache_spec = CACHE_SPEC
 
         fn = functools.partial(self._step, cfg)
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), CACHE_SPEC, P()),
-            out_specs=(P(), CACHE_SPEC),
+            in_specs=(self._specs, P(), self._cache_spec, P()),
+            out_specs=(P(), self._cache_spec),
             check_vma=False,
         )
         self._jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -237,8 +275,8 @@ class TensorParallelForward:
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), CACHE_SPEC, P(), P()),
-            out_specs=(P(), CACHE_SPEC, P()),
+            in_specs=(self._specs, P(), self._cache_spec, P(), P()),
+            out_specs=(P(), self._cache_spec, P()),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -270,8 +308,8 @@ class TensorParallelForward:
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), CACHE_SPEC, P(), P(), P(), P()),
-            out_specs=(P(), CACHE_SPEC, P()),
+            in_specs=(self._specs, P(), self._cache_spec, P(), P(), P(), P()),
+            out_specs=(P(), self._cache_spec, P()),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -345,13 +383,16 @@ class TensorParallelForward:
         return elapsed_ms / n_tokens
 
     def init_cache(self, dtype=jnp.float32):
-        shape = (
-            self.cfg.n_layers,
-            2,
-            self.cfg.seq_len,
-            self.cfg.n_kv_heads,
-            self.cfg.head_size,
-        )
+        layer_shape = (2, self.cfg.seq_len, self.cfg.n_kv_heads, self.cfg.head_size)
+        if self.layered:  # layered cache (see _cache_spec)
+            sharding = NamedSharding(self.mesh, CACHE_SPEC_LAYER)
+            per_shard = layer_shape[:2] + (layer_shape[2] // self.tp,) + layer_shape[3:]
+            zeros = np.zeros(per_shard, dtype)
+            return [
+                jax.make_array_from_callback(layer_shape, sharding, lambda idx: zeros)
+                for _ in range(self.cfg.n_layers)
+            ]
+        shape = (self.cfg.n_layers,) + layer_shape
         sharding = NamedSharding(self.mesh, CACHE_SPEC)
         per_shard = shape[:3] + (shape[3] // self.tp,) + shape[4:]
         zeros = np.zeros(per_shard, dtype)

@@ -10,8 +10,11 @@
 //   file blocks (reference src/quants.hpp:17-20): per 32 values,
 //     2-byte f16 scale + 16 bytes, low nibble = value j, high = value j+16.
 //   TPU packed (ops/q40.py pack_q40_tpu): for W stored row-major
-//     [d_out, d_in], outputs packed[d_in/2, d_out] with original column pairs
-//     (2i, 2i+1) of W^T in (low, high) nibbles, and scales_t[d_in/32, d_out].
+//     [d_out, d_in], outputs the HALF-SPLIT form packed[n_pad/2, d_out]
+//     (n_pad = padded d_in, zero-scale padding): byte (i, r) holds W^T row i
+//     in the low nibble and row i + n_pad/2 in the high nibble, plus
+//     scales_t[n_pad/32, d_out]. Half-split pairing lets the TPU kernel
+//     contract low/high nibbles against two contiguous windows of x.
 
 #include <cstdint>
 #include <cstring>
@@ -68,15 +71,19 @@ void q40_dequant_f32(const uint8_t* blocks, int64_t n_blocks, float* out) {
     }
 }
 
-// Repack a Q40 tensor from file block order into the TPU layout.
+// Repack a Q40 tensor from file block order into the half-split TPU layout.
 //   blocks:   [d_out * (d_in/32)] file blocks, row-major per output row
-//   packed:   out uint8 [d_in/2, d_out] — MUST be zero-initialized (nibbles
-//             are OR-ed in; each byte receives exactly two writes)
-//   scales_t: out f32 [d_in/32, d_out]
+//   n_pad:    padded input dim (multiple of 64, >= d_in); rows d_in..n_pad-1
+//             carry zero scales so their nibble content never matters, but
+//             packed MUST be zero-initialized (nibbles are OR-ed in)
+//   packed:   out uint8 [n_pad/2, d_out]
+//   scales_t: out f32 [n_pad/32, d_out] — MUST be zero-initialized (padding
+//             scale rows stay 0)
 // Tiled over d_out to keep the transposed writes in cache.
 void q40_repack_tpu(const uint8_t* blocks, int64_t d_out, int64_t d_in,
-                    uint8_t* packed, float* scales_t) {
+                    int64_t n_pad, uint8_t* packed, float* scales_t) {
     const int64_t bpr = d_in / QK;  // blocks per row
+    const int64_t half = n_pad / 2;
     const int64_t TILE = 64;
     for (int64_t r0 = 0; r0 < d_out; r0 += TILE) {
         const int64_t r1 = r0 + TILE < d_out ? r0 + TILE : d_out;
@@ -88,18 +95,19 @@ void q40_repack_tpu(const uint8_t* blocks, int64_t d_out, int64_t d_in,
                 std::memcpy(&h, blk, 2);
                 scales_t[b * d_out + r] = f16_to_f32(h);
                 const uint8_t* qs = blk + 2;
-                // value index v within the row: v = b*32 + j (low nibble)
-                // or b*32 + 16 + j (high nibble). Output byte at
-                // packed[v/2 * d_out + r], low nibble if v even.
+                // value index v within the row: v = b*32 + j (low nibble of
+                // qs[j]) or b*32 + 16 + j (high nibble). Output byte at
+                // packed[(v % half) * d_out + r]: low nibble if v < half,
+                // high nibble otherwise.
                 for (int j = 0; j < QK / 2; j++) {
-                    const int v_lo = (int)(b * QK) + j;
-                    const int v_hi = v_lo + QK / 2;
-                    const uint8_t lo_val = qs[j] & 0xF;
-                    const uint8_t hi_val = qs[j] >> 4;
-                    uint8_t* p_lo = packed + (int64_t)(v_lo >> 1) * d_out + r;
-                    uint8_t* p_hi = packed + (int64_t)(v_hi >> 1) * d_out + r;
-                    *p_lo |= (v_lo & 1) ? (uint8_t)(lo_val << 4) : lo_val;
-                    *p_hi |= (v_hi & 1) ? (uint8_t)(hi_val << 4) : hi_val;
+                    const int64_t v_a = b * QK + j;
+                    const int64_t v_b = v_a + QK / 2;
+                    const uint8_t a_val = qs[j] & 0xF;
+                    const uint8_t b_val = qs[j] >> 4;
+                    uint8_t* p_a = packed + (v_a % half) * d_out + r;
+                    uint8_t* p_b = packed + (v_b % half) * d_out + r;
+                    *p_a |= (v_a < half) ? a_val : (uint8_t)(a_val << 4);
+                    *p_b |= (v_b < half) ? b_val : (uint8_t)(b_val << 4);
                 }
             }
         }

@@ -33,16 +33,18 @@ class TestQ40Native:
         qs, scales = quantize_q40(w)
         raw = np.frombuffer(q40_to_bytes(qs, scales), np.uint8)
 
-        got = native.q40_repack_tpu(raw, d_out, d_in)
+        got = native.q40_repack_tpu(raw, d_out, d_in, d_in)  # d_in=128 needs no padding
         assert got is not None
         packed_n, scales_n = got
 
         # python reference path (bypass the native fast path inside
-        # pack_q40_tpu by computing it manually)
+        # pack_q40_tpu by computing it manually): half-split pairing —
+        # low nibble = row i, high nibble = row i + n/2 of W^T
         lo = qs.reshape(d_out, -1, 16) & 0xF
         hi = qs.reshape(d_out, -1, 16) >> 4
         vals = np.concatenate([lo, hi], axis=-1).reshape(d_out, d_in).T
-        want_packed = (vals[0::2] | (vals[1::2] << 4)).astype(np.uint8)
+        half = d_in // 2  # d_in=128 is already a multiple of 64 (no padding)
+        want_packed = (vals[:half] | (vals[half:] << 4)).astype(np.uint8)
         want_scales = scales.reshape(d_out, -1).astype(np.float32).T
 
         np.testing.assert_array_equal(packed_n, want_packed)

@@ -47,7 +47,7 @@ class TestPacking:
         leaves = jax.tree.leaves(qm)
         assert len(leaves) == 2
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), qm, qm)
-        assert stacked.qs.shape == (2, 16, 64)
+        assert stacked.qs.shape == (2, 32, 64)  # n=32 padded to 64, half-split
 
 
 class TestMatmul:
