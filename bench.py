@@ -79,13 +79,14 @@ def random_q40_params_on_device(cfg):
     from distributed_llama_tpu.models.rope import build_rope_table
     from distributed_llama_tpu.ops.q40 import QuantizedMatrix
 
+    from distributed_llama_tpu.ops.q40 import _d_padded, _n_padded
+
     keys = iter(jax.random.split(jax.random.PRNGKey(0), 8 * cfg.n_layers + 8))
 
-    def pad_to(v, m):
-        return -(-v // m) * m if v > m else v
-
     def qmat(n, d):
-        n_pad, d_pad = pad_to(n, 512), pad_to(d, 1024)
+        # the padding rule lives in ops.q40 — a local copy desyncing would
+        # silently route the bench onto the slow XLA fallback
+        n_pad, d_pad = _n_padded(n), _d_padded(d)
         qs = jax.random.bits(next(keys), (n_pad // 2, d_pad), dtype=jnp.uint8)
         scales = jnp.full((n_pad // 32, d_pad), 1.0 / 256, jnp.float32)
         return QuantizedMatrix(qs, scales, n_logical=n, d_logical=d)
@@ -139,14 +140,15 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
 
     t0 = time.perf_counter()
     logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(0))
-    np.asarray(logits)  # host fetch: the only reliable wait on the tunneled runtime
+    np.asarray(logits[-1])  # fetch ONE row: the serving pattern (engine.prefill);
+    # a full [64, 32k] f32 fetch costs ~2 s through the remote tunnel
     prefill_ms = (time.perf_counter() - t0) * 1000.0  # COLD: includes XLA compile
 
     # warm prefill: same shape at a later position reuses the executable —
     # this is the steady-state serving number (round-2 verdict item #4)
     t0 = time.perf_counter()
     logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(prefill_len))
-    np.asarray(logits)
+    np.asarray(logits[-1])
     prefill_warm_ms = (time.perf_counter() - t0) * 1000.0
 
     token = jnp.int32(np.argmax(np.asarray(logits[-1])))
@@ -172,10 +174,10 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     pos += steps
 
     # user path: the chunked streaming decode the CLI/API actually run
-    # (decode_chunk per 16 tokens, host stop-handling between dispatches)
+    # (decode_chunk per 32 tokens, host stop-handling between dispatches)
     from distributed_llama_tpu.models.sampling import decode_chunk
 
-    chunk = 16
+    chunk = 32
     tok_j = tokens[-1]
     key = jax.random.PRNGKey(2)
     toks, cache, key = decode_chunk(cfg, params, tok_j, cache, jnp.int32(pos), chunk,
@@ -254,6 +256,12 @@ def main():
         )
         q40 = json.loads(out.stdout.strip().splitlines()[-1])
         result["detail"]["q40_decode_tokens_per_sec"] = q40["value"]
+        result["detail"]["q40_chunked_decode_tokens_per_sec"] = q40["detail"].get(
+            "chunked_decode_tokens_per_sec"
+        )
+        result["detail"]["q40_prefill_ms_64_tokens_warm"] = q40["detail"].get(
+            "prefill_ms_64_tokens_warm"
+        )
     except Exception as e:
         sys.stderr.write(f"q40 bench failed: {type(e).__name__}: {e}\n")
     result["detail"]["device"] = str(device)

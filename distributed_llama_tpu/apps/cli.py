@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel shards (chips)")
     p.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel shards: KV cache sharded over the sequence, "
+        "ring-attention prefill (long-context mode; exclusive with --tp)",
+    )
+    p.add_argument(
         "--dtype",
         choices=["bf16", "f32", "q40"],
         default="bf16",
@@ -65,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
         "sampler, one host<->device round trip per token)",
     )
     p.add_argument(
-        "--decode-chunk", type=int, default=16,
+        "--decode-chunk", type=int, default=32,
         help="tokens per device dispatch for --decode device",
     )
     # accepted-for-parity flags (see module docstring)
@@ -96,7 +101,8 @@ def make_engine(args):
         )
     dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32, "q40": QUANTIZED_DTYPE}[args.dtype]
     engine = InferenceEngine(
-        args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp
+        args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp,
+        sp=getattr(args, "sp", 1),
     )
     tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
     seed = args.seed if args.seed is not None else int(time.time())

@@ -36,6 +36,7 @@ def load_params(
     cfg: LlamaConfig | None = None,
     dtype=jnp.bfloat16,
     tp: int = 1,
+    mesh=None,
 ) -> Params:
     """Build the host-side params pytree (numpy, not yet on device).
 
@@ -49,8 +50,12 @@ def load_params(
     sharded layout: each shard's slice is READ from the file independently
     (raw_rows / raw_row_blocks — the read-time equivalent of the reference's
     RowMatmulSlice/ColMatmulSlice scatter, src/commands.cpp:11-108 +
-    src/transformer.cpp:432-451), packed, and concatenated so a NamedSharding
-    device_put lands each pack on its device unchanged.
+    src/transformer.cpp:432-451). With ``mesh`` set, the packs are placed
+    via ``jax.make_array_from_callback``: each PROCESS builds (and reads)
+    only the shards of its addressable devices — per-host RAM and file
+    traffic are O(model/tp), the property that makes a 238 GB 405B file
+    loadable across a pod. Without a mesh they are concatenated on host for
+    a later NamedSharding device_put (single-host fallback).
     """
     spec = reader.spec
     cfg = cfg or config_from_spec(spec)
@@ -132,10 +137,63 @@ def load_params(
         return quantize_q40_tpu(np.ascontiguousarray(w))
 
     def sharded(builder, *args):
-        from distributed_llama_tpu.ops.q40 import concat_shard_packs
+        from distributed_llama_tpu.ops.q40 import (
+            QuantizedMatrix,
+            _d_padded,
+            _n_padded,
+            concat_shard_packs,
+        )
 
         axis = "out" if builder is shard_out else "in"
-        return concat_shard_packs([builder(*args, s) for s in range(tp)], axis)
+        if mesh is None:
+            return concat_shard_packs([builder(*args, s) for s in range(tp)], axis)
+
+        # lazy per-shard placement: analytic shard shapes + a callback that
+        # builds (reads) one shard's pack only when a local device asks
+        import jax.sharding as shd
+
+        if axis == "out":
+            entries_ = [reader.entries[nm] for nm in args[0]]
+            d_shard = sum(e.shape[0] for e in entries_) // tp
+            n_shard = entries_[0].shape[1]
+        else:
+            e = reader.entries[args[0]]
+            d_shard = e.shape[0]
+            n_shard = e.shape[1] // tp
+        np_, dp = _n_padded(n_shard), _d_padded(d_shard)
+        qs_shard = (np_ // 2, dp)
+        sc_shard = (np_ // 32, dp)
+        ax = 1 if axis == "out" else 0
+        spec = shd.PartitionSpec(None, "tp") if axis == "out" else shd.PartitionSpec("tp", None)
+        qs_gshape = tuple(
+            s * tp if i == ax else s for i, s in enumerate(qs_shard)
+        )
+        sc_gshape = tuple(
+            s * tp if i == ax else s for i, s in enumerate(sc_shard)
+        )
+        built: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+        def build(s: int):
+            if s not in built:
+                qm = builder(*args, s)
+                qs_np, sc_np = np.asarray(qm.qs), np.asarray(qm.scales)
+                assert qs_np.shape == qs_shard and sc_np.shape == sc_shard, (
+                    f"analytic shard shape mismatch: {qs_np.shape} vs {qs_shard}"
+                )
+                built[s] = (qs_np, sc_np)
+            return built[s]
+
+        def qs_cb(idx):
+            return build((idx[ax].start or 0) // qs_shard[ax])[0]
+
+        def sc_cb(idx):
+            return build((idx[ax].start or 0) // sc_shard[ax])[1]
+
+        ns = shd.NamedSharding(mesh, spec)
+        qs_g = jax.make_array_from_callback(qs_gshape, ns, qs_cb)
+        sc_g = jax.make_array_from_callback(sc_gshape, ns, sc_cb)
+        built.clear()  # free host copies; the data lives on device now
+        return QuantizedMatrix(qs_g, sc_g, n_logical=n_shard, d_logical=d_shard)
 
     layers: dict[str, list] = {}
 
@@ -335,11 +393,12 @@ def load_model(
     dtype=jnp.bfloat16,
     max_seq_len: int | None = None,
     tp: int = 1,
+    mesh=None,
     **cfg_overrides,
 ) -> tuple[ModelSpec, LlamaConfig, Params]:
     reader = ModelFileReader(path)
     spec = reader.spec.clamp_seq_len(max_seq_len)
     cfg = config_from_spec(spec, **cfg_overrides)
-    params = load_params(reader, cfg, dtype=dtype, tp=tp)
+    params = load_params(reader, cfg, dtype=dtype, tp=tp, mesh=mesh)
     reader.close()
     return spec, cfg, params

@@ -72,6 +72,89 @@ def _matmul(x: jax.Array, w) -> jax.Array:
     )
 
 
+def project_qkv(
+    cfg: LlamaConfig,
+    lp: Params,
+    x: jax.Array,
+    rope_rows: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Norm + QKV projection + rope for T tokens: [T, dim] ->
+    (q [T, Hl, hd], k [T, Kl, hd], v [T, Kl, hd]). Shared by the dense,
+    tensor-parallel and sequence-parallel attention paths (the reference's
+    llamaRmsAtt/llamaQkv/llamaRope chain, src/llama2-tasks.cpp:10-52)."""
+    T = x.shape[0]
+    hd = cfg.head_size
+    xn = rmsnorm(x, lp["rms_att"])
+    if "qkv" in lp:
+        # q|k|v packed as one matmul on the output dim (the q40 path: one
+        # large bandwidth-efficient kernel call instead of three small ones)
+        xc = xn.astype(lp["qkv"].dtype)
+        fused = _matmul(xc, lp["qkv"])  # [T, (Hl+2*Kl)*hd] f32
+        d_q = lp["wo"].shape[-2]  # Hl*hd (wo's input dim)
+        d_kv = (fused.shape[-1] - d_q) // 2
+        q = fused[:, :d_q]
+        k = fused[:, d_q : d_q + d_kv]
+        v = fused[:, d_q + d_kv :]
+    else:
+        xc = xn.astype(lp["q"].dtype)
+        q = _matmul(xc, lp["q"])  # [T, Hl*hd] f32
+        k = _matmul(xc, lp["k"])  # [T, Kl*hd]
+        v = _matmul(xc, lp["v"])  # [T, Kl*hd]
+    Hl = q.shape[-1] // hd
+    Kl = k.shape[-1] // hd
+    q = apply_rope(q.reshape(T, Hl, hd), rope_rows, cfg)
+    k = apply_rope(k.reshape(T, Kl, hd), rope_rows, cfg)
+    return q, k, v.reshape(T, Kl, hd)
+
+
+def block_tail(
+    cfg: LlamaConfig,
+    x: jax.Array,
+    att: jax.Array,
+    lp: Params,
+    axis_name: str | None,
+) -> jax.Array:
+    """Everything after the attention mix: wo projection (+psum under TP),
+    the arch-dependent residual/norm placement, and the FFN/MoE half.
+    ``att``: [T, Hl*hd]."""
+    out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
+    if axis_name is not None:
+        # the TP all-reduce: replaces gather + merge-add on root
+        # (reference: src/llama2-tasks.cpp:115-131) with one ICI collective
+        out = jax.lax.psum(out, axis_name)
+    if cfg.arch.name == "GROK1":
+        # grok rmsnorms the attention output with rmsFfn before the residual
+        # add (reference: src/grok1-tasks.cpp:16-41)
+        x = x + rmsnorm(out.astype(x.dtype), lp["rms_ffn"])
+    else:
+        x = x + out.astype(x.dtype)
+    if cfg.is_moe:
+        from distributed_llama_tpu.models import moe
+
+        x = moe.moe_block(cfg, x, lp, axis_name)
+    else:
+        x = x + ffn(cfg, x, lp, axis_name).astype(x.dtype)
+    return x
+
+
+def final_logits(cfg: LlamaConfig, params: Params, x: jax.Array) -> jax.Array:
+    """Final rmsnorm + logits head (+Grok's logit scale),
+    reference: src/llama2-tasks.cpp:222-239, src/grok1-tasks.cpp:270-273."""
+    x = rmsnorm(x, params["rms_final"])
+    logits = _matmul(x.astype(params["wcls"].dtype), params["wcls"])
+    if cfg.arch.name == "GROK1":
+        logits = logits * 0.5773502691896257
+    return logits
+
+
+def embed(cfg: LlamaConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    """Embedding row gather (+Grok's input scale, src/grok1-tasks.cpp:11-14)."""
+    x = params["embedding"][tokens].astype(jnp.float32)
+    if cfg.arch.name == "GROK1":
+        x = x * 78.38367176906169
+    return x
+
+
 def attention(
     cfg: LlamaConfig,
     x: jax.Array,
@@ -92,31 +175,8 @@ def attention(
     T = x.shape[0]
     S = cache_l.shape[1]
     hd = cfg.head_size
-    xn = rmsnorm(x, lp["rms_att"])
-
-    if "qkv" in lp:
-        # q|k|v packed as one matmul on the output dim (the q40 path: one
-        # large bandwidth-efficient kernel call instead of three small ones)
-        xc = xn.astype(lp["qkv"].dtype)
-        fused = _matmul(xc, lp["qkv"])  # [T, (Hl+2*Kl)*hd] f32
-        d_q = lp["wo"].shape[-2]  # Hl*hd (wo's input dim)
-        d_kv = (fused.shape[-1] - d_q) // 2
-        q = fused[:, :d_q]
-        k = fused[:, d_q : d_q + d_kv]
-        v = fused[:, d_q + d_kv :]
-    else:
-        xc = xn.astype(lp["q"].dtype)
-        q = _matmul(xc, lp["q"])  # [T, Hl*hd] f32
-        k = _matmul(xc, lp["k"])  # [T, Kl*hd]
-        v = _matmul(xc, lp["v"])  # [T, Kl*hd]
-    Hl = q.shape[-1] // hd
-    Kl = k.shape[-1] // hd
-    q = q.reshape(T, Hl, hd)
-    k = k.reshape(T, Kl, hd)
-    v = v.reshape(T, Kl, hd)
-
-    q = apply_rope(q, rope_rows, cfg)
-    k = apply_rope(k, rope_rows, cfg)
+    q, k, v = project_qkv(cfg, lp, x, rope_rows)
+    Hl, Kl = q.shape[1], k.shape[1]
 
     cache_dtype = cache_l.dtype
     keys = jax.lax.dynamic_update_slice(
@@ -148,13 +208,7 @@ def attention(
         "tkms,skh->tkmh", weights.astype(cdt), values, precision=prec,
         preferred_element_type=jnp.float32,
     ).reshape(T, Hl * hd)
-
-    out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
-    if axis_name is not None:
-        # the TP all-reduce: replaces gather + merge-add on root
-        # (reference: src/llama2-tasks.cpp:115-131) with one ICI collective
-        out = jax.lax.psum(out, axis_name)
-    return out, new_cache
+    return att, new_cache
 
 
 def ffn(cfg: LlamaConfig, x: jax.Array, lp: Params, axis_name: str | None) -> jax.Array:
@@ -183,20 +237,8 @@ def block_forward(
     rope_rows: jax.Array,
     axis_name: str | None,
 ) -> tuple[jax.Array, jax.Array]:
-    att_out, new_cache = attention(cfg, x, lp, cache_l, pos, rope_rows, axis_name)
-    if cfg.arch.name == "GROK1":
-        # grok rmsnorms the attention output with rmsFfn before the residual
-        # add (reference: src/grok1-tasks.cpp:16-41)
-        x = x + rmsnorm(att_out.astype(x.dtype), lp["rms_ffn"])
-    else:
-        x = x + att_out.astype(x.dtype)
-    if cfg.is_moe:
-        from distributed_llama_tpu.models import moe
-
-        x = moe.moe_block(cfg, x, lp, axis_name)
-    else:
-        x = x + ffn(cfg, x, lp, axis_name).astype(x.dtype)
-    return x, new_cache
+    att, new_cache = attention(cfg, x, lp, cache_l, pos, rope_rows, axis_name)
+    return block_tail(cfg, x, att, lp, axis_name), new_cache
 
 
 def forward_tokens(
@@ -214,13 +256,10 @@ def forward_tokens(
     reference's Inference::infer (src/tasks.cpp:173-184) is the T=1 case.
     """
     T = tokens.shape[0]
-    x = params["embedding"][tokens].astype(jnp.float32)
+    x = embed(cfg, params, tokens)
     rope_rows = jax.lax.dynamic_slice(
         params["rope_table"], (pos, 0, 0), (T,) + params["rope_table"].shape[1:]
     )
-
-    if cfg.arch.name == "GROK1":
-        x = x * 78.38367176906169  # input scale (reference: src/grok1-tasks.cpp:11-14)
 
     if isinstance(params["layers"], (list, tuple)):
         # unrolled layer loop: used by the q40 path, whose Pallas-call
@@ -246,11 +285,7 @@ def forward_tokens(
 
         x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
 
-    x = rmsnorm(x, params["rms_final"])
-    logits = _matmul(x.astype(params["wcls"].dtype), params["wcls"])
-    if cfg.arch.name == "GROK1":
-        logits = logits * 0.5773502691896257  # (reference: src/grok1-tasks.cpp:270-273)
-    return logits, new_cache
+    return final_logits(cfg, params, x), new_cache
 
 
 def init_cache(

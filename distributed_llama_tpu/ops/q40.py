@@ -45,10 +45,14 @@ from distributed_llama_tpu.quants import QK
 # dominates. Env overrides exist for tuning on other chip generations.
 import os as _os
 
-BLOCK_N = int(_os.environ.get("DLT_BN", 1024))  # input tile (MULTIPLE OF 512:
-# the x window needs bn/2 % 128 == 0 and the scales tile bn/64 % 8 == 0 —
-# smaller values silently push every matmul onto the slow XLA fallback)
+BLOCK_N = int(_os.environ.get("DLT_BN", 1024))  # input tile (multiple of 512:
+# the x window needs bn/2 % 128 == 0 and the scales tile bn/64 % 8 == 0)
 BLOCK_D = int(_os.environ.get("DLT_BD", 1024))  # output tile (multiple of 128)
+if BLOCK_N % 512 or BLOCK_N <= 0:
+    raise ValueError(f"DLT_BN={BLOCK_N} must be a positive multiple of 512 "
+                     "(otherwise every matmul silently takes the slow XLA fallback)")
+if BLOCK_D % 128 or BLOCK_D <= 0:
+    raise ValueError(f"DLT_BD={BLOCK_D} must be a positive multiple of 128")
 
 
 @jax.tree_util.register_pytree_node_class

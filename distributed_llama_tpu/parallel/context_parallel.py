@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 def _chunk_attention(
     q: jax.Array,  # [Tq, K, M, hd] f32 (grouped: K kv-heads × M q-per-kv)
-    k: jax.Array,  # [Tk, K, hd]
+    k: jax.Array,  # [Tk, K, hd] — cache dtype (NOT pre-cast to f32)
     v: jax.Array,  # [Tk, K, hd]
     q_positions: jax.Array,  # [Tq] global positions
     k_positions: jax.Array,  # [Tk]
@@ -36,10 +36,16 @@ def _chunk_attention(
     """Masked scores of one (q-chunk, kv-chunk) pair → (m, l, o) partials.
 
     m: running max [Tq, K, M]; l: exp-sum [Tq, K, M]; o: weighted V sum
-    [Tq, K, M, hd]. Entirely local — no collectives.
+    [Tq, K, M, hd]. Entirely local — no collectives. The einsums run with
+    k/v in their storage dtype and f32 accumulation: pre-casting a bf16
+    cache slice to f32 would materialize 2x the cache bytes per layer per
+    token (the same fix as llama.attention's score/value einsums).
     """
     hd = q.shape[-1]
-    scores = jnp.einsum("tkmh,skh->tkms", q, k) / jnp.sqrt(jnp.float32(hd))
+    cdt = k.dtype
+    scores = jnp.einsum(
+        "tkmh,skh->tkms", q.astype(cdt), k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(hd))
     mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)  # [Tq, K, M]
@@ -48,7 +54,9 @@ def _chunk_attention(
     p = jnp.exp(scores - safe_m[..., None])
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("tkms,skh->tkmh", p, v)
+    o = jnp.einsum(
+        "tkms,skh->tkmh", p.astype(cdt), v, preferred_element_type=jnp.float32
+    )
     return safe_m, l, o
 
 
@@ -89,7 +97,9 @@ def ring_attention(
         kc, vc, m, l, o = carry
         src_chunk = (idx - s) % n  # whose kv chunk we currently hold
         k_pos = src_chunk * Tk + jnp.arange(Tk)
-        ms, ls, os_ = _chunk_attention(qg, kc.astype(jnp.float32), vc.astype(jnp.float32), q_pos, k_pos)
+        # kc/vc stay in cache dtype: the ring ppermute then moves half the
+        # bytes for a bf16 cache, and _chunk_attention accumulates in f32
+        ms, ls, os_ = _chunk_attention(qg, kc, vc, q_pos, k_pos)
         m, l, o = _merge(m, l, o, ms, ls, os_)
         # rotate kv around the ring: device i sends to i+1 (so chunks walk
         # backwards relative to each device's view)
@@ -124,9 +134,7 @@ def sp_decode_attention(
     qg = q.reshape(1, K, kv_mul, hd).astype(jnp.float32)
     positions = idx * Sl + jnp.arange(Sl)
     q_pos = jnp.asarray([pos])
-    m, l, o = _chunk_attention(
-        qg, k_local.astype(jnp.float32), v_local.astype(jnp.float32), q_pos, positions
-    )
+    m, l, o = _chunk_attention(qg, k_local, v_local, q_pos, positions)
     # cross-device online-softmax merge
     g_m = jax.lax.pmax(m, axis_name)
     scale = jnp.exp(m - g_m)
@@ -134,3 +142,294 @@ def sp_decode_attention(
     g_o = jax.lax.psum(o * scale[..., None], axis_name)
     out = g_o / jnp.maximum(g_l, 1e-30)[..., None]
     return out.reshape(H, hd)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel engine backend
+# ---------------------------------------------------------------------------
+
+
+class SequenceParallelForward:
+    """Sequence/context parallelism as an engine backend: the KV cache is
+    sharded along the SEQUENCE axis over an ``sp`` mesh (device i owns slots
+    [i*S/n, (i+1)*S/n)), weights are replicated, prefill runs
+    :func:`ring_attention` over position chunks, and decode attends its local
+    cache slice with the cross-device online-softmax merge of
+    :func:`sp_decode_attention`.
+
+    This is the long-context strategy the reference lacks entirely
+    (SURVEY.md §5): per-device KV memory drops to 1/n — the same memory
+    shape as the reference's per-node KvCacheSlice (src/commands.cpp:97-102)
+    but over the sequence instead of heads, so it composes with long
+    contexts rather than head counts.
+
+    Design contract: prefill processes the FULL padded context (the prompt
+    is padded to seq_len so every device owns exactly its cache slice's
+    positions — uniform chunks are what make the ring collective regular).
+    That makes prefill cost O(S) regardless of prompt length: sp is a
+    long-context feature, use tp for short-prompt serving.
+    """
+
+    def __init__(self, cfg, sp: int, devices=None):
+        import functools
+
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from distributed_llama_tpu.parallel.tensor_parallel import shard_map
+
+        if cfg.seq_len % sp:
+            raise ValueError(f"sp={sp} must divide seq_len={cfg.seq_len}")
+        self.cfg = cfg
+        self.sp = sp
+        if devices is None:
+            devices = jax.devices()[:sp]
+        if len(devices) < sp:
+            raise ValueError(f"need {sp} devices, have {len(devices)}")
+        self.mesh = Mesh(mesh_utils.create_device_mesh((sp,), devices=devices), ("sp",))
+        self._P = P
+        self._NamedSharding = NamedSharding
+        self._shard_map = shard_map
+        self._cache_spec = [P(None, "sp", None, None)] * cfg.n_layers
+        self._param_spec = P()  # replicated
+        self._decode_cache: dict = {}
+
+        prefill = shard_map(
+            functools.partial(_sp_prefill, cfg),
+            mesh=self.mesh,
+            in_specs=(P(), P("sp"), self._cache_spec),
+            out_specs=(P("sp"), self._cache_spec),
+            check_vma=False,
+        )
+        self._prefill = jax.jit(prefill, donate_argnums=(2,))
+
+        step = shard_map(
+            functools.partial(_sp_decode_step, cfg),
+            mesh=self.mesh,
+            in_specs=(P(), P(), self._cache_spec, P()),
+            out_specs=(P(), self._cache_spec),
+            check_vma=False,
+        )
+        self._step = jax.jit(step, donate_argnums=(2,))
+
+    # -- engine interface ---------------------------------------------------
+
+    def shard_params(self, host_params):
+        rep = self._NamedSharding(self.mesh, self._P())
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, rep), host_params)
+
+    def init_cache(self, dtype=jnp.float32):
+        import numpy as np
+
+        cfg = self.cfg
+        shape = (2, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
+        sharding = self._NamedSharding(self.mesh, self._P(None, "sp", None, None))
+        per_shard = (2, cfg.seq_len // self.sp, cfg.n_kv_heads, cfg.head_size)
+        zeros = np.zeros(per_shard, dtype)
+        return [
+            jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
+            for _ in range(cfg.n_layers)
+        ]
+
+    def forward(self, params, tokens, cache, pos):
+        """Engine forward: T==1 routes to the decode step; T>1 at pos 0 is
+        the ring-attention full-context prefill (tokens padded to seq_len —
+        every device owns exactly its cache slice's positions). A multi-token
+        forward at pos > 0 (a chat/API delta prompt against a live cache)
+        falls back to stepwise decode-path consumption: correct, one
+        dispatch per token — sp optimizes the long FIRST prefill."""
+        tokens = jnp.asarray(tokens)
+        T = tokens.shape[0]
+        if T == 1:
+            return self._step(params, tokens, cache, jnp.asarray(pos))
+        if int(pos) != 0:
+            rows = []
+            for i in range(T):
+                row, cache = self._step(
+                    params, tokens[i : i + 1], cache, jnp.asarray(int(pos) + i)
+                )
+                rows.append(row)
+            return jnp.concatenate(rows, axis=0), cache
+        S = self.cfg.seq_len
+        if T != S:
+            tokens = jnp.pad(tokens, (0, S - tokens.shape[0]))
+        return self._prefill(params, tokens, cache)
+
+    def decode_loop(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
+        tokens, cache, _ = self._decode_scan(int(n_steps), float(temperature), float(topp))(
+            params, jnp.asarray(first_token), cache, jnp.asarray(pos), key
+        )
+        return tokens, cache
+
+    def decode_chunk(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
+        jitted = self._decode_scan(int(n_steps), None, None)
+        return jitted(
+            params, jnp.asarray(first_token), cache, jnp.asarray(pos),
+            jnp.float32(temperature), jnp.float32(topp), key,
+        )
+
+    def _decode_scan(self, n_steps: int, temperature, topp):
+        """Jitted on-device decode loop; temperature/topp static when given
+        (decode_loop) or traced scalars when None (decode_chunk — one
+        compiled program per chunk size serves every sampler setting)."""
+        from distributed_llama_tpu.models import sampling
+
+        P = self._P
+        key_ = (n_steps, temperature, topp)
+        cached = self._decode_cache.get(key_)
+        if cached is not None:
+            return cached
+        cfg = self.cfg
+
+        def scan_body(params, first_token, cache, pos, key, t, p):
+            def step(carry, _):
+                token, cache_c, pp, k = carry
+                logits, cache_c = _sp_decode_step(cfg, params, token[None], cache_c, pp)
+                k, sub = jax.random.split(k)
+                nxt = sampling.sample_token(logits[0], sub, t, p)
+                return (nxt, cache_c, pp + 1, k), nxt
+
+            (_, cache, _, key), tokens = jax.lax.scan(
+                step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32), key),
+                None, length=n_steps,
+            )
+            return tokens, cache, key
+
+        if temperature is None:  # dynamic sampler params
+
+            def fn(params, first_token, cache, pos, t_in, p_in, key):
+                return scan_body(params, first_token, cache, pos, key, t_in, p_in)
+
+            in_specs = (P(), P(), self._cache_spec, P(), P(), P(), P())
+        else:
+
+            def fn(params, first_token, cache, pos, key):
+                return scan_body(params, first_token, cache, pos, key, temperature, topp)
+
+            in_specs = (P(), P(), self._cache_spec, P(), P())
+        mapped = self._shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(P(), self._cache_spec, P()), check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._decode_cache[key_] = jitted
+        return jitted
+
+    def measure_transfer_ms(self, n_tokens: int = 32) -> float:
+        """Per-token collective cost of the sp decode: per layer one pmax +
+        two psums of the online-softmax partials (see sp_decode_attention),
+        timed back-to-back on the real mesh (upper bound; same methodology
+        as TensorParallelForward.measure_transfer_ms)."""
+        import time as _time
+
+        cfg = self.cfg
+        H, hd = cfg.n_heads, cfg.head_size
+        K = cfg.n_kv_heads
+        M = H // K
+
+        def token_step(carry, _):
+            m, o = carry
+
+            def layer(c, _):
+                mm, oo = c
+                g_m = jax.lax.pmax(mm, "sp")
+                g_l = jax.lax.psum(mm * 0.5, "sp")
+                g_o = jax.lax.psum(oo, "sp")
+                return (g_m + g_l * 1e-9, g_o * 0.5), None
+
+            (m, o), _ = jax.lax.scan(layer, (m, o), None, length=cfg.n_layers)
+            return (m, o), None
+
+        def fn(m, o):
+            (m, o), _ = jax.lax.scan(token_step, (m, o), None, length=n_tokens)
+            return m, o
+
+        P = self._P
+        mapped = self._shard_map(
+            fn, mesh=self.mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped)
+        m = jnp.ones((1, K, M), jnp.float32)
+        o = jnp.ones((1, K, M, hd), jnp.float32)
+        out = jitted(m, o)
+        jax.block_until_ready(out)
+        import numpy as np
+
+        t0 = _time.perf_counter()
+        np.asarray(jitted(m, o)[0])
+        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+        return elapsed_ms / n_tokens
+
+
+def _sp_prefill(cfg, params, tokens_local, cache):
+    """Per-shard prefill body: ring attention over position chunks. Device i
+    processes positions [i*Tl, (i+1)*Tl) — exactly its cache slice. Block
+    wiring (norms, projections, residuals, FFN/MoE, logits) is shared with
+    the dense path via llama's helpers; only attention differs."""
+    from distributed_llama_tpu.models import llama
+
+    idx = jax.lax.axis_index("sp")
+    Tl = tokens_local.shape[0]
+    offset = idx * Tl
+    x = llama.embed(cfg, params, tokens_local)
+    rope_rows = jax.lax.dynamic_slice(
+        params["rope_table"], (offset, 0, 0),
+        (Tl,) + params["rope_table"].shape[1:],
+    )
+
+    new_cache = []
+    for lp, cache_l in zip(params["layers"], cache):
+        q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
+        H = q.shape[1]
+        cdt = cache_l.dtype
+        k = k.astype(cdt)
+        v = v.astype(cdt)
+        new_cache.append(jnp.stack([k, v]))
+        att = ring_attention(
+            q.astype(jnp.float32), k, v, "sp", chunk_offset=offset
+        ).reshape(Tl, H * cfg.head_size)
+        x = llama.block_tail(cfg, x, att, lp, None)
+
+    return llama.final_logits(cfg, params, x), new_cache
+
+
+def _sp_decode_step(cfg, params, tokens, cache, pos):
+    """Per-shard single-token decode: replicated compute except attention,
+    which reads only the local cache slice and merges partials across the
+    ring. The new token's K/V row is written on the owning shard only."""
+    from distributed_llama_tpu.models import llama
+
+    idx = jax.lax.axis_index("sp")
+    x = llama.embed(cfg, params, tokens)  # [1, dim]
+    rope_rows = jax.lax.dynamic_slice(
+        params["rope_table"], (pos, 0, 0), (1,) + params["rope_table"].shape[1:]
+    )
+    hd = cfg.head_size
+
+    new_cache = []
+    for lp, cache_l in zip(params["layers"], cache):
+        Sl = cache_l.shape[1]
+        q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
+        H, K = q.shape[1], k.shape[1]
+
+        # write the new K/V row on the owning shard: every shard performs the
+        # same dynamic_update_slice (aliasing-friendly), non-owners write the
+        # row they already had back into place
+        owner = (pos >= idx * Sl) & (pos < (idx + 1) * Sl)
+        lpos = jnp.clip(pos - idx * Sl, 0, Sl - 1)
+        cdt = cache_l.dtype
+        old_k = jax.lax.dynamic_slice(cache_l[0], (lpos, 0, 0), (1, K, hd))
+        old_v = jax.lax.dynamic_slice(cache_l[1], (lpos, 0, 0), (1, K, hd))
+        k_row = jnp.where(owner, k.astype(cdt), old_k)
+        v_row = jnp.where(owner, v.astype(cdt), old_v)
+        keys = jax.lax.dynamic_update_slice(cache_l[0], k_row, (lpos, 0, 0))
+        values = jax.lax.dynamic_update_slice(cache_l[1], v_row, (lpos, 0, 0))
+        new_cache.append(jnp.stack([keys, values]))
+
+        att = sp_decode_attention(
+            q[0].astype(jnp.float32), keys, values, pos, "sp"
+        ).reshape(1, H * hd)
+        x = llama.block_tail(cfg, x, att, lp, None)
+
+    return llama.final_logits(cfg, params, x), new_cache

@@ -188,7 +188,7 @@ class ApiState:
 
                 engine.stream_decode(
                     token, on_token, params["temperature"], self.args.topp,
-                    seed=seed, chunk=getattr(self.args, "decode_chunk", 16),
+                    seed=seed, chunk=getattr(self.args, "decode_chunk", 32),
                     limit=max_pos,
                 )
             else:
