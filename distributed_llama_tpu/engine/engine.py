@@ -170,9 +170,17 @@ class InferenceEngine:
         callers append their fetch to the same stats entry implicitly by
         measuring around their np.asarray)."""
         n = tokens.shape[0]
+        if n == 0:
+            raise ValueError("empty token batch: at least one token required")
         if self.pos + n > self.cfg.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {n} > {self.cfg.seq_len}")
-        if n == 1:
+        if n == 1 or (
+            # backends that consume mid-context prompts stepwise (sp) would
+            # dispatch one full model step per PAD token and write pad K/V
+            # rows into the live cache — give them the exact length instead
+            self.pos > 0
+            and getattr(self._tp_engine, "prefers_exact_mid_prefill", False)
+        ):
             padded = tokens
         else:
             bucket = _prefill_bucket(n)

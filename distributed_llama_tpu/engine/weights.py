@@ -387,18 +387,3 @@ def random_params_on_device(
         cfg, mat, ones, embedding, jnp.asarray(build_rope_table(cfg)), layered=layered
     )
 
-
-def load_model(
-    path: str,
-    dtype=jnp.bfloat16,
-    max_seq_len: int | None = None,
-    tp: int = 1,
-    mesh=None,
-    **cfg_overrides,
-) -> tuple[ModelSpec, LlamaConfig, Params]:
-    reader = ModelFileReader(path)
-    spec = reader.spec.clamp_seq_len(max_seq_len)
-    cfg = config_from_spec(spec, **cfg_overrides)
-    params = load_params(reader, cfg, dtype=dtype, tp=tp, mesh=mesh)
-    reader.close()
-    return spec, cfg, params

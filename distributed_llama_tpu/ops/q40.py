@@ -47,7 +47,8 @@ import os as _os
 
 BLOCK_N = int(_os.environ.get("DLT_BN", 1024))  # input tile (multiple of 512:
 # the x window needs bn/2 % 128 == 0 and the scales tile bn/64 % 8 == 0)
-BLOCK_D = int(_os.environ.get("DLT_BD", 1024))  # output tile (multiple of 128)
+BLOCK_D = int(_os.environ.get("DLT_BD", 2048))  # output tile (multiple of 128;
+# 2048 profiled ~4% faster than 1024 on v5e decode; T>8 shrinks it for VMEM)
 if BLOCK_N % 512 or BLOCK_N <= 0:
     raise ValueError(f"DLT_BN={BLOCK_N} must be a positive multiple of 512 "
                      "(otherwise every matmul silently takes the slow XLA fallback)")

@@ -193,6 +193,9 @@ class SequenceParallelForward:
         self._cache_spec = [P(None, "sp", None, None)] * cfg.n_layers
         self._param_spec = P()  # replicated
         self._decode_cache: dict = {}
+        # the engine must not bucket-pad mid-context prompts for this
+        # backend: they are consumed stepwise, one dispatch per token
+        self.prefers_exact_mid_prefill = True
 
         prefill = shard_map(
             functools.partial(_sp_prefill, cfg),

@@ -1,0 +1,142 @@
+// Standalone C++ test binary for the native host library — the same test
+// shape as the reference's funcs-test/quants-test mains (standalone
+// executables, exit(1) on failure, reference: src/quants-test.cpp). Built
+// and run under AddressSanitizer in CI (the reference ships no sanitizer
+// lane at all — SURVEY.md §5).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+void q40_dequant_f32(const uint8_t* blocks, int64_t n_blocks, float* out);
+void q40_repack_tpu(const uint8_t* blocks, int64_t d_out, int64_t d_in,
+                    int64_t n_pad, uint8_t* packed, float* scales_t);
+void* bpe_new(const uint8_t* vocab_bytes, const int64_t* offsets,
+              const float* scores, int32_t n_vocab);
+void bpe_free(void* handle);
+int32_t bpe_encode(void* handle, const uint8_t* text, int64_t len, int32_t* out);
+}
+
+#define CHECK(cond)                                                    \
+    do {                                                               \
+        if (!(cond)) {                                                 \
+            std::fprintf(stderr, "FAILED: %s (%s:%d)\n", #cond,        \
+                         __FILE__, __LINE__);                          \
+            std::exit(1);                                              \
+        }                                                              \
+    } while (0)
+
+namespace {
+
+constexpr int QK = 32;
+constexpr int BLOCK_BYTES = 2 + QK / 2;
+
+// minimal f32 -> f16 for building test blocks (round-to-nearest-even not
+// required: we only use exactly-representable scales)
+uint16_t f32_to_f16_exact(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    uint32_t sign = (bits >> 16) & 0x8000;
+    int32_t exp = (int32_t)((bits >> 23) & 0xFF) - 127 + 15;
+    uint32_t mant = (bits >> 13) & 0x3FF;
+    if (f == 0.0f) return (uint16_t)sign;
+    CHECK(exp > 0 && exp < 31);  // test scales stay in normal f16 range
+    return (uint16_t)(sign | ((uint32_t)exp << 10) | mant);
+}
+
+// one Q40 block: scale then 16 nibble bytes (value j low, value j+16 high)
+void write_block(uint8_t* dst, float scale, const int* vals /* 32, biased 0..15 */) {
+    uint16_t h = f32_to_f16_exact(scale);
+    std::memcpy(dst, &h, 2);
+    for (int j = 0; j < QK / 2; j++) {
+        dst[2 + j] = (uint8_t)(vals[j] | (vals[j + QK / 2] << 4));
+    }
+}
+
+void test_dequant() {
+    // two blocks with known scales/values
+    std::vector<uint8_t> blocks(2 * BLOCK_BYTES);
+    int vals[QK];
+    for (int i = 0; i < QK; i++) vals[i] = i % 16;
+    write_block(blocks.data(), 0.5f, vals);
+    for (int i = 0; i < QK; i++) vals[i] = 15 - i % 16;
+    write_block(blocks.data() + BLOCK_BYTES, 2.0f, vals);
+
+    std::vector<float> out(2 * QK);
+    q40_dequant_f32(blocks.data(), 2, out.data());
+    for (int i = 0; i < QK; i++) {
+        CHECK(out[i] == ((i % 16) - 8) * 0.5f);
+        CHECK(out[QK + i] == ((15 - i % 16) - 8) * 2.0f);
+    }
+    std::printf("  dequant: ok\n");
+}
+
+void test_repack_half_split() {
+    // verify the half-split layout: packed[(v % half) * d_out + r] holds
+    // value v of row r, low nibble when v < half. Nibbles stay BIASED
+    // (0..15) — the TPU kernel subtracts the +8 bias as a rank-reduced
+    // correction, not at repack time
+    const int64_t d_out = 4, d_in = 2 * QK, n_pad = 64;  // half = 32: block 0
+    const int64_t bpr = d_in / QK;                       // lands in low nibbles,
+    std::vector<uint8_t> blocks(d_out * bpr * BLOCK_BYTES);  // block 1 in high
+    int vals[QK];
+    for (int64_t r = 0; r < d_out; r++) {
+        for (int64_t b = 0; b < bpr; b++) {
+            for (int i = 0; i < QK; i++) vals[i] = (int)((i + r + 3 * b) % 16);
+            write_block(blocks.data() + (r * bpr + b) * BLOCK_BYTES,
+                        1.0f + (float)(r + b * d_out), vals);
+        }
+    }
+    const int64_t half = n_pad / 2;
+    std::vector<uint8_t> packed(half * d_out, 0);
+    std::vector<float> scales(n_pad / QK * d_out, 0.0f);
+    q40_repack_tpu(blocks.data(), d_out, d_in, n_pad, packed.data(), scales.data());
+
+    for (int64_t r = 0; r < d_out; r++) {
+        CHECK(scales[r] == 1.0f + (float)r);           // block 0 scale row
+        CHECK(scales[d_out + r] == 1.0f + (float)(r + d_out));  // block 1
+        for (int v = 0; v < (int)d_in; v++) {
+            int b = v / QK;
+            int expect = (int)((v % QK + r + 3 * b) % 16);  // biased nibble
+            uint8_t byte = packed[(v % half) * d_out + r];
+            int nib = (v < half) ? (byte & 0xF) : (byte >> 4);
+            CHECK(nib == expect);
+        }
+    }
+    std::printf("  repack: ok\n");
+}
+
+void test_bpe() {
+    // vocab: bytes 'a','b','c', merged token "ab" with the best score
+    const char* toks[] = {"a", "b", "c", "ab"};
+    float scores[] = {-4.0f, -4.0f, -4.0f, -1.0f};
+    std::vector<uint8_t> blob;
+    std::vector<int64_t> offsets = {0};
+    for (const char* t : toks) {
+        for (const char* p = t; *p; p++) blob.push_back((uint8_t)*p);
+        offsets.push_back((int64_t)blob.size());
+    }
+    void* h = bpe_new(blob.data(), offsets.data(), scores, 4);
+    CHECK(h != nullptr);
+    const char* text = "abcab";
+    std::vector<int32_t> out(16);
+    int32_t n = bpe_encode(h, (const uint8_t*)text, 5, out.data());
+    CHECK(n == 3);
+    CHECK(out[0] == 3 && out[1] == 2 && out[2] == 3);  // ab c ab
+    bpe_free(h);
+    std::printf("  bpe: ok\n");
+}
+
+}  // namespace
+
+int main() {
+    test_dequant();
+    test_repack_half_split();
+    test_bpe();
+    std::printf("native_test: all ok ✅\n");
+    return 0;
+}
