@@ -270,13 +270,13 @@ class InferenceEngine:
         self.pos += n_steps
         return tokens
 
-    def decode_chunk(self, first_token: int, n_steps: int, temperature, topp, key):
-        """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
-        temperature/topp (no recompile when a request changes them). Returns
-        (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
+    def _dispatch_chunk(self, first_token, n_steps: int, temperature, topp, key):
+        """Dispatch one decode chunk WITHOUT fetching: returns the device
+        token array and the advanced key. ``first_token`` may be a host int
+        or a device scalar (the previous chunk's last token — the pipelined
+        path never waits on it). Advances pos by n_steps."""
         from distributed_llama_tpu.models import sampling
 
-        start = time.perf_counter()
         if self._tp_engine is not None:
             tokens, self.cache, key = self._tp_engine.decode_chunk(
                 self.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
@@ -288,10 +288,18 @@ class InferenceEngine:
                 jnp.int32(self.pos), n_steps, jnp.float32(temperature),
                 jnp.float32(topp), key,
             )
+        self.pos += n_steps
+        return tokens, key
+
+    def decode_chunk(self, first_token: int, n_steps: int, temperature, topp, key):
+        """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
+        temperature/topp (no recompile when a request changes them). Returns
+        (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
+        start = time.perf_counter()
+        tokens, key = self._dispatch_chunk(first_token, n_steps, temperature, topp, key)
         tokens = np.asarray(tokens)
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         self.stats.extend([self._split_stats(elapsed_ms / n_steps)] * n_steps)
-        self.pos += n_steps
         return tokens, key
 
     def generate_chunks(
@@ -320,17 +328,39 @@ class InferenceEngine:
         This is the user-facing fast path: the stepwise ``decode_step`` loop
         pays a host<->device round trip per token (the reference's regime,
         src/apps/dllama/dllama.cpp:45-59), which behind a remote PJRT tunnel
-        costs more than the forward pass itself.
+        costs more than the forward pass itself. The stream is additionally
+        PIPELINED: chunk k+1 is dispatched (seeded by chunk k's last token,
+        which never leaves the device) BEFORE chunk k's tokens are fetched,
+        so the host-fetch latency overlaps the next chunk's compute. An
+        early stop wastes at most one speculative chunk — already covered by
+        the rollback contract above.
         """
         key = jax.random.PRNGKey(seed)
-        token = int(first_token)
         stop = self.cfg.seq_len if limit is None else min(limit, self.cfg.seq_len)
-        while self.pos < stop:
-            k = min(chunk, self.cfg.seq_len - self.pos)
-            toks, key = self.decode_chunk(token, k, temperature, topp, key)
+        if self.pos >= stop:
+            return
+        k = min(chunk, self.cfg.seq_len - self.pos)
+        pending, key = self._dispatch_chunk(int(first_token), k, temperature, topp, key)
+        pending_n = k
+        while True:
+            # the timed window covers dispatch+fetch only — consumer time
+            # between yields must not be attributed to the engine's stats
+            start = time.perf_counter()
+            # speculatively dispatch the next chunk off the device-resident
+            # last token before fetching the pending one
+            if self.pos < stop:
+                k = min(chunk, self.cfg.seq_len - self.pos)
+                nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
+            else:
+                nxt, k = None, 0
+            toks = np.asarray(pending)
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            self.stats.extend([self._split_stats(elapsed_ms / pending_n)] * pending_n)
             for t in toks.tolist():
                 yield int(t)
-            token = int(toks[-1])
+            if nxt is None:
+                return
+            pending, pending_n = nxt, k
 
     def stream_decode(
         self,
