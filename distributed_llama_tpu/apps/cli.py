@@ -51,7 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--sp", type=int, default=1,
         help="sequence-parallel shards: KV cache sharded over the sequence, "
-        "ring-attention prefill (long-context mode; exclusive with --tp)",
+        "ring-attention prefill (long-context mode; composes with --tp on a "
+        "2-D tp x sp mesh)",
     )
     p.add_argument(
         "--dtype",

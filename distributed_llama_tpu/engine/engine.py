@@ -75,9 +75,6 @@ class InferenceEngine:
         quantized = dtype == "q40"
         self.tp = tp
         self.sp = sp
-        if tp > 1 and sp > 1:
-            raise ValueError("tp and sp are 1-D strategies here; pick one "
-                             "(a 2-D tp x sp mesh is future work)")
         # the parallel backend is constructed BEFORE the weights load so the
         # q40 sharded load can place each shard's pack straight onto its
         # device via make_array_from_callback — each process reads only its
@@ -93,10 +90,13 @@ class InferenceEngine:
         if sp > 1:
             from distributed_llama_tpu.parallel import context_parallel as spmod
 
-            # sequence parallelism: replicated weights, sequence-sharded KV
-            # cache, ring-attention prefill (see SequenceParallelForward);
-            # reuses the tp-engine slot — same duck-typed interface
-            self._tp_engine = spmod.SequenceParallelForward(self.cfg, sp)
+            # sequence parallelism (optionally composed with tensor
+            # parallelism on a 2-D (tp, sp) mesh): sequence-sharded KV cache,
+            # ring-attention prefill (see SequenceParallelForward); reuses
+            # the tp-engine slot — same duck-typed interface
+            self._tp_engine = spmod.SequenceParallelForward(
+                self.cfg, sp, tp=tp, quantized=quantized
+            )
         elif tp > 1:
             from distributed_llama_tpu.parallel import tensor_parallel as tpmod
 

@@ -168,48 +168,77 @@ class SequenceParallelForward:
     positions — uniform chunks are what make the ring collective regular).
     That makes prefill cost O(S) regardless of prompt length: sp is a
     long-context feature, use tp for short-prompt serving.
+
+    ``tp > 1`` composes tensor parallelism on a 2-D ``(tp, sp)`` mesh — the
+    scaling-book recipe the reference's 1-D TCP star cannot express: weights
+    and attention heads shard over ``tp`` (psum after wo/down rides one mesh
+    axis), the sequence and KV cache shard over ``sp`` (ring/online-softmax
+    collectives ride the other), and the KV cache shrinks by tp*sp per
+    device (heads AND sequence).
     """
 
-    def __init__(self, cfg, sp: int, devices=None):
+    def __init__(self, cfg, sp: int, tp: int = 1, quantized: bool = False, devices=None):
         import functools
 
         from jax.experimental import mesh_utils
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        from distributed_llama_tpu.parallel.tensor_parallel import shard_map
+        from distributed_llama_tpu.parallel.tensor_parallel import (
+            param_specs_layered,
+            q40_param_specs,
+            shard_map,
+            validate_tp,
+        )
 
         if cfg.seq_len % sp:
             raise ValueError(f"sp={sp} must divide seq_len={cfg.seq_len}")
+        if tp > 1:
+            validate_tp(cfg, tp, quantized=quantized)
         self.cfg = cfg
         self.sp = sp
+        self.tp = tp
+        self.quantized = quantized
+        n_dev = tp * sp
         if devices is None:
-            devices = jax.devices()[:sp]
-        if len(devices) < sp:
-            raise ValueError(f"need {sp} devices, have {len(devices)}")
-        self.mesh = Mesh(mesh_utils.create_device_mesh((sp,), devices=devices), ("sp",))
+            devices = jax.devices()[:n_dev]
+        if len(devices) < n_dev:
+            raise ValueError(f"need {n_dev} devices (tp*sp), have {len(devices)}")
+        self.mesh = Mesh(
+            mesh_utils.create_device_mesh((tp, sp), devices=devices[:n_dev]),
+            ("tp", "sp"),
+        )
         self._P = P
         self._NamedSharding = NamedSharding
         self._shard_map = shard_map
-        self._cache_spec = [P(None, "sp", None, None)] * cfg.n_layers
-        self._param_spec = P()  # replicated
+        self.shard_vocab = tp > 1 and cfg.vocab_size % tp == 0
+        # KV heads shard over tp, sequence slots over sp
+        cache_ax = P(None, "sp", "tp", None) if tp > 1 else P(None, "sp", None, None)
+        self._cache_spec = [cache_ax] * cfg.n_layers
+        if tp == 1:
+            self._pspecs = P()  # fully replicated params
+        elif quantized:
+            self._pspecs = q40_param_specs(cfg, cfg.n_layers, self.shard_vocab)
+        else:
+            self._pspecs = param_specs_layered(cfg, cfg.n_layers, self.shard_vocab)
+        self._tp_axis = "tp" if tp > 1 else None
         self._decode_cache: dict = {}
         # the engine must not bucket-pad mid-context prompts for this
         # backend: they are consumed stepwise, one dispatch per token
         self.prefers_exact_mid_prefill = True
 
         prefill = shard_map(
-            functools.partial(_sp_prefill, cfg),
+            functools.partial(_sp_prefill, cfg, self._tp_axis),
             mesh=self.mesh,
-            in_specs=(P(), P("sp"), self._cache_spec),
+            in_specs=(self._pspecs, P("sp"), self._cache_spec),
             out_specs=(P("sp"), self._cache_spec),
             check_vma=False,
         )
         self._prefill = jax.jit(prefill, donate_argnums=(2,))
 
         step = shard_map(
-            functools.partial(_sp_decode_step, cfg),
+            functools.partial(_sp_decode_step, cfg, self._tp_axis),
             mesh=self.mesh,
-            in_specs=(P(), P(), self._cache_spec, P()),
+            in_specs=(self._pspecs, P(), self._cache_spec, P()),
             out_specs=(P(), self._cache_spec),
             check_vma=False,
         )
@@ -218,16 +247,19 @@ class SequenceParallelForward:
     # -- engine interface ---------------------------------------------------
 
     def shard_params(self, host_params):
-        rep = self._NamedSharding(self.mesh, self._P())
-        return jax.tree_util.tree_map(lambda a: jax.device_put(a, rep), host_params)
+        from distributed_llama_tpu.parallel.tensor_parallel import place_params
+
+        return place_params(host_params, self._pspecs, self.mesh)
 
     def init_cache(self, dtype=jnp.float32):
         import numpy as np
 
         cfg = self.cfg
         shape = (2, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
-        sharding = self._NamedSharding(self.mesh, self._P(None, "sp", None, None))
-        per_shard = (2, cfg.seq_len // self.sp, cfg.n_kv_heads, cfg.head_size)
+        sharding = self._NamedSharding(self.mesh, self._cache_spec[0])
+        per_shard = (
+            2, cfg.seq_len // self.sp, cfg.n_kv_heads // self.tp, cfg.head_size
+        )
         zeros = np.zeros(per_shard, dtype)
         return [
             jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
@@ -284,10 +316,14 @@ class SequenceParallelForward:
             return cached
         cfg = self.cfg
 
+        tp_axis = self._tp_axis
+
         def scan_body(params, first_token, cache, pos, key, t, p):
             def step(carry, _):
                 token, cache_c, pp, k = carry
-                logits, cache_c = _sp_decode_step(cfg, params, token[None], cache_c, pp)
+                logits, cache_c = _sp_decode_step(
+                    cfg, tp_axis, params, token[None], cache_c, pp
+                )
                 k, sub = jax.random.split(k)
                 nxt = sampling.sample_token(logits[0], sub, t, p)
                 return (nxt, cache_c, pp + 1, k), nxt
@@ -303,13 +339,13 @@ class SequenceParallelForward:
             def fn(params, first_token, cache, pos, t_in, p_in, key):
                 return scan_body(params, first_token, cache, pos, key, t_in, p_in)
 
-            in_specs = (P(), P(), self._cache_spec, P(), P(), P(), P())
+            in_specs = (self._pspecs, P(), self._cache_spec, P(), P(), P(), P())
         else:
 
             def fn(params, first_token, cache, pos, key):
                 return scan_body(params, first_token, cache, pos, key, temperature, topp)
 
-            in_specs = (P(), P(), self._cache_spec, P(), P())
+            in_specs = (self._pspecs, P(), self._cache_spec, P(), P())
         mapped = self._shard_map(
             fn, mesh=self.mesh, in_specs=in_specs,
             out_specs=(P(), self._cache_spec, P()), check_vma=False,
@@ -321,55 +357,76 @@ class SequenceParallelForward:
     def measure_transfer_ms(self, n_tokens: int = 32) -> float:
         """Per-token collective cost of the sp decode: per layer one pmax +
         two psums of the online-softmax partials (see sp_decode_attention),
-        timed back-to-back on the real mesh (upper bound; same methodology
-        as TensorParallelForward.measure_transfer_ms)."""
+        plus the two tp all-reduces when a 2-D mesh is in use, timed
+        back-to-back on the real mesh (upper bound; same methodology as
+        TensorParallelForward.measure_transfer_ms)."""
         import time as _time
 
         cfg = self.cfg
         H, hd = cfg.n_heads, cfg.head_size
-        K = cfg.n_kv_heads
-        M = H // K
+        K = cfg.n_kv_heads // self.tp  # local KV heads under the 2-D mesh
+        M = max(1, (H // self.tp) // max(K, 1))
+        tp_axis = self._tp_axis
 
         def token_step(carry, _):
-            m, o = carry
+            m, o, z = carry
 
             def layer(c, _):
-                mm, oo = c
+                mm, oo, zz = c
                 g_m = jax.lax.pmax(mm, "sp")
                 g_l = jax.lax.psum(mm * 0.5, "sp")
                 g_o = jax.lax.psum(oo, "sp")
-                return (g_m + g_l * 1e-9, g_o * 0.5), None
+                if tp_axis is not None:
+                    # the wo/down all-reduces carry a FULL [1, dim]
+                    # activation each (llama.block_tail), not the smaller
+                    # attention partials — model them at true size
+                    zz = jax.lax.psum(zz, tp_axis) * 0.5
+                    zz = jax.lax.psum(zz, tp_axis) * 0.5
+                return (g_m + g_l * 1e-9, g_o * 0.5, zz), None
 
-            (m, o), _ = jax.lax.scan(layer, (m, o), None, length=cfg.n_layers)
-            return (m, o), None
+            (m, o, z), _ = jax.lax.scan(layer, (m, o, z), None, length=cfg.n_layers)
+            return (m, o, z), None
 
-        def fn(m, o):
-            (m, o), _ = jax.lax.scan(token_step, (m, o), None, length=n_tokens)
-            return m, o
+        def fn(m, o, z):
+            (m, o, z), _ = jax.lax.scan(token_step, (m, o, z), None, length=n_tokens)
+            return m, o, z
 
         P = self._P
         mapped = self._shard_map(
-            fn, mesh=self.mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            fn, mesh=self.mesh, in_specs=(P(), P(), P()), out_specs=(P(), P(), P()),
             check_vma=False,
         )
         jitted = jax.jit(mapped)
         m = jnp.ones((1, K, M), jnp.float32)
         o = jnp.ones((1, K, M, hd), jnp.float32)
-        out = jitted(m, o)
+        z = jnp.ones((1, cfg.dim), jnp.float32)
+        out = jitted(m, o, z)
         jax.block_until_ready(out)
         import numpy as np
 
         t0 = _time.perf_counter()
-        np.asarray(jitted(m, o)[0])
+        np.asarray(jitted(m, o, z)[0])
         elapsed_ms = (_time.perf_counter() - t0) * 1000.0
         return elapsed_ms / n_tokens
 
 
-def _sp_prefill(cfg, params, tokens_local, cache):
+def _sp_logits(cfg, tp_axis, params, x):
+    """Final logits with the optional tp vocab-shard all-gather."""
+    from distributed_llama_tpu.models import llama
+
+    logits = llama.final_logits(cfg, params, x)
+    if tp_axis is not None and logits.shape[-1] != cfg.vocab_size:
+        logits = jax.lax.all_gather(logits, tp_axis, axis=1, tiled=True)
+    return logits
+
+
+def _sp_prefill(cfg, tp_axis, params, tokens_local, cache):
     """Per-shard prefill body: ring attention over position chunks. Device i
     processes positions [i*Tl, (i+1)*Tl) — exactly its cache slice. Block
     wiring (norms, projections, residuals, FFN/MoE, logits) is shared with
-    the dense path via llama's helpers; only attention differs."""
+    the dense path via llama's helpers; only attention differs. Under a 2-D
+    mesh, projections/FFN are tp-sharded (psum over ``tp_axis``) while the
+    ring rides ``sp`` — the two collective families never mix."""
     from distributed_llama_tpu.models import llama
 
     idx = jax.lax.axis_index("sp")
@@ -392,12 +449,12 @@ def _sp_prefill(cfg, params, tokens_local, cache):
         att = ring_attention(
             q.astype(jnp.float32), k, v, "sp", chunk_offset=offset
         ).reshape(Tl, H * cfg.head_size)
-        x = llama.block_tail(cfg, x, att, lp, None)
+        x = llama.block_tail(cfg, x, att, lp, tp_axis)
 
-    return llama.final_logits(cfg, params, x), new_cache
+    return _sp_logits(cfg, tp_axis, params, x), new_cache
 
 
-def _sp_decode_step(cfg, params, tokens, cache, pos):
+def _sp_decode_step(cfg, tp_axis, params, tokens, cache, pos):
     """Per-shard single-token decode: replicated compute except attention,
     which reads only the local cache slice and merges partials across the
     ring. The new token's K/V row is written on the owning shard only."""
@@ -433,6 +490,6 @@ def _sp_decode_step(cfg, params, tokens, cache, pos):
         att = sp_decode_attention(
             q[0].astype(jnp.float32), keys, values, pos, "sp"
         ).reshape(1, H * hd)
-        x = llama.block_tail(cfg, x, att, lp, None)
+        x = llama.block_tail(cfg, x, att, lp, tp_axis)
 
-    return llama.final_logits(cfg, params, x), new_cache
+    return _sp_logits(cfg, tp_axis, params, x), new_cache

@@ -163,6 +163,42 @@ CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
 CACHE_SPEC_LAYER = P(None, None, "tp", None)  # [2, S, K, hd] (q40 layered cache)
 
 
+def place_params(host_params, specs, mesh) -> Any:
+    """device_put a params tree against a matching PartitionSpec tree.
+
+    Explicit recursion: PartitionSpec is a tuple subclass (and
+    QuantizedMatrix a custom node), so tree.map over the spec tree would
+    descend into the specs themselves. A single PartitionSpec acts as a
+    prefix covering the whole tree (the replicated case)."""
+    from jax.sharding import PartitionSpec as _P
+
+    from distributed_llama_tpu.ops.q40 import QuantizedMatrix
+
+    def rec(p, s):
+        if isinstance(s, _P):
+            if isinstance(p, dict):
+                return {k: rec(p[k], s) for k in p}
+            if isinstance(p, list):
+                return [rec(pi, s) for pi in p]
+        elif isinstance(p, dict):
+            return {k: rec(p[k], s[k]) for k in p}
+        elif isinstance(p, list):
+            return [rec(pi, si) for pi, si in zip(p, s)]
+        if isinstance(p, QuantizedMatrix):
+            # one spec covers both leaves: qs and scales shard along the
+            # same axis index
+            ns = NamedSharding(mesh, s)
+            return QuantizedMatrix(
+                jax.device_put(p.qs, ns),
+                jax.device_put(p.scales, ns),
+                p.n_logical,
+                p.d_logical,
+            )
+        return jax.device_put(p, NamedSharding(mesh, s))
+
+    return rec(host_params, specs)
+
+
 class TensorParallelForward:
     """Jitted shard_map'd forward over a 1-D ``tp`` mesh.
 
@@ -231,29 +267,7 @@ class TensorParallelForward:
     # ------------------------------------------------------------------
 
     def shard_params(self, host_params) -> Any:
-        from distributed_llama_tpu.ops.q40 import QuantizedMatrix
-
-        # explicit recursion: PartitionSpec is a tuple subclass (and
-        # QuantizedMatrix a custom node), so tree.map over the spec tree
-        # would descend into the specs themselves
-        def rec(p, s):
-            if isinstance(p, dict):
-                return {k: rec(p[k], s[k]) for k in p}
-            if isinstance(p, list):
-                return [rec(pi, si) for pi, si in zip(p, s)]
-            if isinstance(p, QuantizedMatrix):
-                # one spec covers both leaves: qs [n/2, d] and scales
-                # [n/32, d] shard along the same axis index
-                ns = NamedSharding(self.mesh, s)
-                return QuantizedMatrix(
-                    jax.device_put(p.qs, ns),
-                    jax.device_put(p.scales, ns),
-                    p.n_logical,
-                    p.d_logical,
-                )
-            return jax.device_put(p, NamedSharding(self.mesh, s))
-
-        return rec(host_params, self._specs)
+        return place_params(host_params, self._specs, self.mesh)
 
     def _decode_jitted(self, n_steps: int, temperature: float, topp: float):
         # per-instance cache (an lru_cache on the method would pin self and
