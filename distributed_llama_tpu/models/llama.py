@@ -159,31 +159,37 @@ def attention(
     cfg: LlamaConfig,
     x: jax.Array,
     lp: Params,
-    cache_l: jax.Array,
+    cache_l,
     pos: jax.Array,
     rope_rows: jax.Array,
     axis_name: str | None,
 ) -> tuple[jax.Array, jax.Array]:
     """Causal GQA attention for T new tokens at absolute positions
-    pos..pos+T-1. ``cache_l``: [2, S, Kl, hd] (keys, values) for this layer's
-    local KV heads; returns (output [T, dim_local_out], updated cache).
+    pos..pos+T-1. ``cache_l``: this layer's cache — a ``(keys, values)``
+    tuple of [S, Kl, hd] arrays (the layered layout, updated in place) or a
+    stacked [2, S, Kl, hd] array (the lax.scan-over-layers layout); returns
+    (attention mix [T, Hl*hd], updated cache in the same form).
 
     Mirrors llamaQkv/llamaRope/llamaMultiheadAtt/llamaAtt
     (reference: src/llama2-tasks.cpp:33-108) with the per-timestep score loop
     replaced by one masked einsum over the whole cache.
     """
     T = x.shape[0]
-    S = cache_l.shape[1]
+    S = cache_l[0].shape[0]  # works for tuple (keys, values) and stacked [2, S, ...] forms
     hd = cfg.head_size
     q, k, v = project_qkv(cfg, lp, x, rope_rows)
     Hl, Kl = q.shape[1], k.shape[1]
 
-    cache_dtype = cache_l.dtype
+    cache_dtype = cache_l[0].dtype
     keys = jax.lax.dynamic_update_slice(
         cache_l[0], k.astype(cache_dtype), (pos, 0, 0)
     )  # [S, Kl, hd]
     values = jax.lax.dynamic_update_slice(cache_l[1], v.astype(cache_dtype), (pos, 0, 0))
-    new_cache = jnp.stack([keys, values])
+    # per-layer TUPLE caches (the layered layout) update in place; stacking
+    # into a [2, S, Kl, hd] array would copy the layer's ENTIRE cache every
+    # step (~1.3 ms/token across 32 layers of a 7B, profiled) because XLA
+    # cannot alias a stack of two updated slices back onto the original
+    new_cache = (keys, values) if isinstance(cache_l, tuple) else jnp.stack([keys, values])
 
     kv_mul = Hl // Kl
     # score/value einsums run with operands in the CACHE dtype and f32
@@ -232,7 +238,7 @@ def block_forward(
     cfg: LlamaConfig,
     x: jax.Array,
     lp: Params,
-    cache_l: jax.Array,
+    cache_l,
     pos: jax.Array,
     rope_rows: jax.Array,
     axis_name: str | None,
@@ -251,9 +257,11 @@ def forward_tokens(
 ) -> tuple[jax.Array, jax.Array]:
     """Run T tokens through the model starting at absolute position ``pos``.
 
-    tokens: int32 [T]; cache: [L, 2, S, Kl, hd]; returns
-    (logits f32 [T, vocab], updated cache). The per-token path of the
-    reference's Inference::infer (src/tasks.cpp:173-184) is the T=1 case.
+    tokens: int32 [T]; cache: a list of per-layer ``(keys, values)`` tuples
+    (the layered layout) or a stacked [L, 2, S, Kl, hd] array; returns
+    (logits f32 [T, vocab], updated cache in the same form). The per-token
+    path of the reference's Inference::infer (src/tasks.cpp:173-184) is the
+    T=1 case.
     """
     T = tokens.shape[0]
     x = embed(cfg, params, tokens)
@@ -274,7 +282,7 @@ def forward_tokens(
         for l, lp in enumerate(params["layers"]):
             x, nc = block_forward(cfg, x, lp, cache[l], pos, rope_rows, axis_name)
             new_layers.append(nc)
-        new_cache = new_layers if cache_is_list else jnp.stack(new_layers)
+        new_cache = type(cache)(new_layers) if cache_is_list else jnp.stack(new_layers)
     else:
 
         def body(carry, scanned):
@@ -293,15 +301,19 @@ def init_cache(
     n_kv_heads_local: int | None = None,
     dtype=jnp.float32,
     layered: bool = False,
-) -> jax.Array | list[jax.Array]:
+) -> jax.Array | list[tuple[jax.Array, jax.Array]]:
     """Preallocated KV cache [L, 2, S, Kl, hd]
     (reference: KvCacheSlice, src/commands.cpp:97-102).
 
-    ``layered=True`` returns a list of per-layer [2, S, Kl, hd] arrays — the
-    form the unrolled (q40) forward needs so in-place cache updates alias
-    instead of copying the whole cache each step (see forward_tokens)."""
+    ``layered=True`` returns a list of per-layer ``(keys, values)`` tuples
+    of [S, Kl, hd] arrays — the form the unrolled forward needs so in-place
+    cache updates alias per leaf instead of copying the whole cache each
+    step (see attention)."""
     kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
-    shape = (2, cfg.seq_len, kl, cfg.head_size)
+    shape = (cfg.seq_len, kl, cfg.head_size)
     if layered:
-        return [jnp.zeros(shape, dtype=dtype) for _ in range(cfg.n_layers)]
-    return jnp.zeros((cfg.n_layers,) + shape, dtype=dtype)
+        return [
+            (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+            for _ in range(cfg.n_layers)
+        ]
+    return jnp.zeros((cfg.n_layers, 2) + shape, dtype=dtype)

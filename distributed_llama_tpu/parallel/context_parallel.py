@@ -211,8 +211,10 @@ class SequenceParallelForward:
         self._NamedSharding = NamedSharding
         self._shard_map = shard_map
         self.shard_vocab = tp > 1 and cfg.vocab_size % tp == 0
-        # KV heads shard over tp, sequence slots over sp
-        cache_ax = P(None, "sp", "tp", None) if tp > 1 else P(None, "sp", None, None)
+        # per-layer (keys, values) tuples of [S, K, hd]: sequence slots
+        # shard over sp, KV heads over tp (one spec is the pytree prefix
+        # covering both tuple leaves)
+        cache_ax = P("sp", "tp", None) if tp > 1 else P("sp", None, None)
         self._cache_spec = [cache_ax] * cfg.n_layers
         if tp == 1:
             self._pspecs = P()  # fully replicated params
@@ -255,16 +257,17 @@ class SequenceParallelForward:
         import numpy as np
 
         cfg = self.cfg
-        shape = (2, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
+        shape = (cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
         sharding = self._NamedSharding(self.mesh, self._cache_spec[0])
         per_shard = (
-            2, cfg.seq_len // self.sp, cfg.n_kv_heads // self.tp, cfg.head_size
+            cfg.seq_len // self.sp, cfg.n_kv_heads // self.tp, cfg.head_size
         )
         zeros = np.zeros(per_shard, dtype)
-        return [
-            jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
-            for _ in range(cfg.n_layers)
-        ]
+
+        def arr():
+            return jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
+
+        return [(arr(), arr()) for _ in range(cfg.n_layers)]
 
     def forward(self, params, tokens, cache, pos):
         """Engine forward: T==1 routes to the decode step; T>1 at pos 0 is
@@ -442,10 +445,10 @@ def _sp_prefill(cfg, tp_axis, params, tokens_local, cache):
     for lp, cache_l in zip(params["layers"], cache):
         q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
         H = q.shape[1]
-        cdt = cache_l.dtype
+        cdt = cache_l[0].dtype
         k = k.astype(cdt)
         v = v.astype(cdt)
-        new_cache.append(jnp.stack([k, v]))
+        new_cache.append((k, v))
         att = ring_attention(
             q.astype(jnp.float32), k, v, "sp", chunk_offset=offset
         ).reshape(Tl, H * cfg.head_size)
@@ -469,7 +472,7 @@ def _sp_decode_step(cfg, tp_axis, params, tokens, cache, pos):
 
     new_cache = []
     for lp, cache_l in zip(params["layers"], cache):
-        Sl = cache_l.shape[1]
+        Sl = cache_l[0].shape[0]
         q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
         H, K = q.shape[1], k.shape[1]
 
@@ -478,14 +481,14 @@ def _sp_decode_step(cfg, tp_axis, params, tokens, cache, pos):
         # row they already had back into place
         owner = (pos >= idx * Sl) & (pos < (idx + 1) * Sl)
         lpos = jnp.clip(pos - idx * Sl, 0, Sl - 1)
-        cdt = cache_l.dtype
+        cdt = cache_l[0].dtype
         old_k = jax.lax.dynamic_slice(cache_l[0], (lpos, 0, 0), (1, K, hd))
         old_v = jax.lax.dynamic_slice(cache_l[1], (lpos, 0, 0), (1, K, hd))
         k_row = jnp.where(owner, k.astype(cdt), old_k)
         v_row = jnp.where(owner, v.astype(cdt), old_v)
         keys = jax.lax.dynamic_update_slice(cache_l[0], k_row, (lpos, 0, 0))
         values = jax.lax.dynamic_update_slice(cache_l[1], v_row, (lpos, 0, 0))
-        new_cache.append(jnp.stack([keys, values]))
+        new_cache.append((keys, values))
 
         att = sp_decode_attention(
             q[0].astype(jnp.float32), keys, values, pos, "sp"

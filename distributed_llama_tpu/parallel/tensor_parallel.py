@@ -160,7 +160,7 @@ def q40_param_specs(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[
 
 
 CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
-CACHE_SPEC_LAYER = P(None, None, "tp", None)  # [2, S, K, hd] (q40 layered cache)
+CACHE_SPEC_LAYER = P(None, "tp", None)  # per-layer (keys, values) tuples of [S, K, hd]
 
 
 def place_params(host_params, specs, mesh) -> Any:
@@ -397,16 +397,17 @@ class TensorParallelForward:
         return elapsed_ms / n_tokens
 
     def init_cache(self, dtype=jnp.float32):
-        layer_shape = (2, self.cfg.seq_len, self.cfg.n_kv_heads, self.cfg.head_size)
-        if self.layered:  # layered cache (see _cache_spec)
+        kv_shape = (self.cfg.seq_len, self.cfg.n_kv_heads, self.cfg.head_size)
+        if self.layered:  # per-layer (keys, values) tuples (see _cache_spec)
             sharding = NamedSharding(self.mesh, CACHE_SPEC_LAYER)
-            per_shard = layer_shape[:2] + (layer_shape[2] // self.tp,) + layer_shape[3:]
+            per_shard = (kv_shape[0], kv_shape[1] // self.tp, kv_shape[2])
             zeros = np.zeros(per_shard, dtype)
-            return [
-                jax.make_array_from_callback(layer_shape, sharding, lambda idx: zeros)
-                for _ in range(self.cfg.n_layers)
-            ]
-        shape = (self.cfg.n_layers,) + layer_shape
+
+            def arr():
+                return jax.make_array_from_callback(kv_shape, sharding, lambda idx: zeros)
+
+            return [(arr(), arr()) for _ in range(self.cfg.n_layers)]
+        shape = (self.cfg.n_layers, 2) + kv_shape
         sharding = NamedSharding(self.mesh, CACHE_SPEC)
         per_shard = shape[:3] + (shape[3] // self.tp,) + shape[4:]
         zeros = np.zeros(per_shard, dtype)

@@ -171,10 +171,13 @@ class TestSequenceParallelEngine:
         path = self._model(tmp_path)
         esp = InferenceEngine(path, dtype=jnp.float32, sp=4)
         shard_shapes = {
-            s.data.shape for layer in esp.cache for s in layer.addressable_shards
+            s.data.shape
+            for layer in esp.cache
+            for half in layer
+            for s in half.addressable_shards
         }
         # seq 32 / sp 4 = 8 positions per shard
-        assert shard_shapes == {(2, 8, 4, 8)}
+        assert shard_shapes == {(8, 4, 8)}
 
     def test_sp_mid_context_prefill_matches_dense(self, tmp_path):
         """Chat/API delta prompts prefill at pos > 0 against the live cache;
@@ -243,10 +246,13 @@ class TestTpSpMesh:
         path = self._model(tmp_path)
         e = InferenceEngine(path, dtype=jnp.float32, tp=2, sp=4)
         shard_shapes = {
-            s.data.shape for layer in e.cache for s in layer.addressable_shards
+            s.data.shape
+            for layer in e.cache
+            for half in layer
+            for s in half.addressable_shards
         }
         # seq 32/sp4 = 8 slots, kv heads 4/tp2 = 2 per shard
-        assert shard_shapes == {(2, 8, 2, 16)}
+        assert shard_shapes == {(8, 2, 16)}
 
     def test_tpsp_q40_greedy_stream(self, tmp_path):
         """The production format on the 2-D mesh: Q40 sharded packs through

@@ -102,4 +102,4 @@ class TestLlamaForward:
         write_model_file(path, spec, tensors)
         engine = InferenceEngine(path, dtype=jnp.float32, max_seq_len=16)
         assert engine.cfg.seq_len == 16
-        assert engine.cache[0].shape[1] == 16  # layered cache: [2, S, K, hd] per layer
+        assert engine.cache[0][0].shape[0] == 16  # layered cache: (keys, values) of [S, K, hd]

@@ -78,12 +78,15 @@ class TestTensorParallel:
 
     def test_kv_cache_is_sharded(self, tmp_path):
         engine, _ = build(tmp_path, spec_8heads(), tp=4)
-        # layered cache: one [2, S, K, hd] array per layer, sharded on K
+        # layered cache: per-layer (keys, values) tuples sharded on K
         assert isinstance(engine.cache, list) and len(engine.cache) == 2
         shard_shapes = {
-            s.data.shape for layer in engine.cache for s in layer.addressable_shards
+            s.data.shape
+            for layer in engine.cache
+            for half in layer
+            for s in half.addressable_shards
         }
-        assert shard_shapes == {(2, 24, 2, 8)}  # K axis 8/4=2 per shard
+        assert shard_shapes == {(24, 2, 8)}  # K axis 8/4=2 per shard
 
     def test_tp_on_device_decode_matches_dense(self, tmp_path):
         """The shard_map'd decode loop (one dispatch for N tokens,
