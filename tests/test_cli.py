@@ -92,3 +92,15 @@ class TestCli:
                 ["generate", "--model", model, "--tokenizer", tok, "--prompt", "x",
                  "--steps", "2", "--kv-cache-storage", "disc"]
             )
+
+    def test_tp_sp_combined(self, model_files, capsys):
+        """The 2-D (tp, sp) mesh through the user-facing CLI."""
+        model, tok = model_files
+        run_cli(
+            ["inference", "--model", model, "--tokenizer", tok, "--prompt", "hello",
+             "--steps", "6", "--temperature", "0", "--dtype", "f32",
+             "--tp", "2", "--sp", "2"]
+        )
+        out = capsys.readouterr().out
+        assert "Generated tokens:" in out
+        assert "Avg transfer time:" in out
