@@ -133,12 +133,22 @@ class InferenceEngine:
             self._transfer_ms = self._tp_engine.measure_transfer_ms()
         return self._transfer_ms
 
-    def _split_stats(self, per_entry_ms: float, n_tokens: int = 1) -> TokenStats:
+    def _last_dispatches(self) -> int:
+        """How many device programs the most recent forward issued (the sp
+        backend's chunked mid-context prefill issues several; every other
+        path is exactly one)."""
+        return getattr(self._tp_engine, "last_forward_dispatches", 1) or 1
+
+    def _split_stats(
+        self, per_entry_ms: float, n_tokens: int = 1, n_dispatches: int = 1
+    ) -> TokenStats:
         """I/T split of one timed dispatch: the measured collective cost is an
         upper bound (XLA overlaps collectives with compute in the real
         program), so clamp it to the observed time — inference_ms must not go
-        negative."""
-        transfer = min(self._transfer_ms_per_token(), per_entry_ms)
+        negative. An entry that covers several dispatches (the sp backend's
+        chunked mid-context prefill) pays the collective sequence once per
+        dispatch."""
+        transfer = min(self._transfer_ms_per_token() * n_dispatches, per_entry_ms)
         return TokenStats(
             per_entry_ms, per_entry_ms - transfer, transfer, n_tokens=n_tokens
         )
@@ -175,9 +185,9 @@ class InferenceEngine:
         if self.pos + n > self.cfg.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {n} > {self.cfg.seq_len}")
         if n == 1 or (
-            # backends that consume mid-context prompts stepwise (sp) would
-            # dispatch one full model step per PAD token and write pad K/V
-            # rows into the live cache — give them the exact length instead
+            # backends that chunk mid-context prompts themselves (sp) pad to
+            # their own fixed chunk width — engine bucket-padding on top
+            # would only inflate the dispatch count
             self.pos > 0
             and getattr(self._tp_engine, "prefers_exact_mid_prefill", False)
         ):
@@ -202,8 +212,9 @@ class InferenceEngine:
         start = time.perf_counter()
         logits = np.asarray(self._forward_device(tokens)[:n])
         elapsed = (time.perf_counter() - start) * 1000.0
-        # one program dispatch = one collective sequence, however many tokens
-        self.stats.append(self._split_stats(elapsed, n_tokens=n))
+        self.stats.append(
+            self._split_stats(elapsed, n_tokens=n, n_dispatches=self._last_dispatches())
+        )
         return logits
 
     def prefill(self, tokens: list[int]) -> np.ndarray:
@@ -218,7 +229,9 @@ class InferenceEngine:
         start = time.perf_counter()
         logits = np.asarray(self._forward_device(tokens)[n - 1])
         elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(self._split_stats(elapsed, n_tokens=n))
+        self.stats.append(
+            self._split_stats(elapsed, n_tokens=n, n_dispatches=self._last_dispatches())
+        )
         return logits
 
     def decode_step(self, token: int) -> np.ndarray:

@@ -177,9 +177,14 @@ def load_params(
             if s not in built:
                 qm = builder(*args, s)
                 qs_np, sc_np = np.asarray(qm.qs), np.asarray(qm.scales)
-                assert qs_np.shape == qs_shard and sc_np.shape == sc_shard, (
-                    f"analytic shard shape mismatch: {qs_np.shape} vs {qs_shard}"
-                )
+                # a real error, not an assert: under python -O a
+                # builder/analytic-shape desync would otherwise surface as an
+                # opaque make_array_from_callback failure far from the cause
+                if qs_np.shape != qs_shard or sc_np.shape != sc_shard:
+                    raise ValueError(
+                        f"analytic shard shape mismatch: built {qs_np.shape}/"
+                        f"{sc_np.shape}, expected {qs_shard}/{sc_shard}"
+                    )
                 built[s] = (qs_np, sc_np)
             return built[s]
 

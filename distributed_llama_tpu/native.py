@@ -41,10 +41,11 @@ def _stale() -> bool:
     binary corrupts memory, so rebuild first."""
     try:
         lib_mtime = os.path.getmtime(_LIB_PATH)
+        # the Makefile is part of the ABI too (CXXFLAGS/defines changes)
         return any(
             os.path.getmtime(os.path.join(_LIB_DIR, f)) > lib_mtime
             for f in os.listdir(_LIB_DIR)
-            if f.endswith((".cpp", ".h"))
+            if f.endswith((".cpp", ".h", ".hpp")) or f == "Makefile"
         )
     except OSError:
         return True

@@ -49,11 +49,19 @@ BLOCK_N = int(_os.environ.get("DLT_BN", 1024))  # input tile (multiple of 512:
 # the x window needs bn/2 % 128 == 0 and the scales tile bn/64 % 8 == 0)
 BLOCK_D = int(_os.environ.get("DLT_BD", 2048))  # output tile (multiple of 128;
 # 2048 profiled ~4% faster than 1024 on v5e decode; T>8 shrinks it for VMEM)
-if BLOCK_N % 512 or BLOCK_N <= 0:
-    raise ValueError(f"DLT_BN={BLOCK_N} must be a positive multiple of 512 "
-                     "(otherwise every matmul silently takes the slow XLA fallback)")
-if BLOCK_D % 128 or BLOCK_D <= 0:
-    raise ValueError(f"DLT_BD={BLOCK_D} must be a positive multiple of 128")
+
+
+def _validate_env_tiles() -> None:
+    """Validates the DLT_BN/DLT_BD env overrides at first kernel use, not
+    import time: a bad tuning value must fail pointing at the knob, not make
+    the whole package (including --help) unimportable. Only the env-derived
+    module defaults are checked (explicit block_n/block_d arguments have
+    looser rules — _largest_divisor_tile snaps them to legal tiles)."""
+    if BLOCK_N % 512 or BLOCK_N <= 0:
+        raise ValueError(f"DLT_BN={BLOCK_N} must be a positive multiple of 512 "
+                         "(otherwise every matmul silently takes the slow XLA fallback)")
+    if BLOCK_D % 128 or BLOCK_D <= 0:
+        raise ValueError(f"DLT_BD={BLOCK_D} must be a positive multiple of 128")
 
 
 @jax.tree_util.register_pytree_node_class
@@ -318,6 +326,7 @@ def q40_matmul(
     n, d = qm.n, qm.d
     np_, dp = qm.n_padded, qm.d_padded
     T = x.shape[0]
+    _validate_env_tiles()
     # VMEM budget (measured on v5e, 16MB scoped limit): the dominant tiles
     # are the int32 + 2x bf16 dequant forms (~8 B per packed element) plus
     # the [T, bd] f32 accumulator; shrink the output tile as T grows

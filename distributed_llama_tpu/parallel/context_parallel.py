@@ -11,12 +11,15 @@ parallelism is first-class:
   ring with ``jax.lax.ppermute`` while each device accumulates its query
   chunk's output with an online (flash-style) softmax — compute overlaps the
   ICI transfer, and no device ever materializes the full sequence.
-* :func:`sp_decode_attention` — single-token decode against a
-  sequence-sharded KV cache: each device attends over its local cache slice,
-  then the partial (max, denominator, numerator) triples merge across the
-  ring with one pmax + two psums.
+* :func:`sp_sharded_attention` — Tq query rows against a sequence-sharded
+  KV cache: each device attends over its local cache slice, then the
+  partial (max, denominator, numerator) triples merge across the ring with
+  one pmax + two psums. Tq==1 (:func:`sp_decode_attention`) is the decode
+  step; Tq>1 drives the chunked mid-context prefill (:func:`_sp_chunk_forward`)
+  that consumes chat/API delta prompts against a live cache in
+  ceil(T/chunk) dispatches.
 
-Both run inside ``shard_map`` and are validated against full attention on a
+All run inside ``shard_map`` and are validated against full attention on a
 virtual CPU mesh (tests/test_context_parallel.py).
 """
 
@@ -43,8 +46,13 @@ def _chunk_attention(
     """
     hd = q.shape[-1]
     cdt = k.dtype
+    # f32 caches (parity tests) keep true-f32 multiplies, mirroring
+    # llama.attention — otherwise TPU's default bf16 demotion makes f32 SP
+    # runs diverge from the dense f32 path
+    prec = jax.lax.Precision.HIGHEST if cdt == jnp.float32 else None
     scores = jnp.einsum(
-        "tkmh,skh->tkms", q.astype(cdt), k, preferred_element_type=jnp.float32
+        "tkmh,skh->tkms", q.astype(cdt), k, precision=prec,
+        preferred_element_type=jnp.float32,
     ) / jnp.sqrt(jnp.float32(hd))
     mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, -jnp.inf)
@@ -55,7 +63,8 @@ def _chunk_attention(
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum(
-        "tkms,skh->tkmh", p.astype(cdt), v, preferred_element_type=jnp.float32
+        "tkms,skh->tkmh", p.astype(cdt), v, precision=prec,
+        preferred_element_type=jnp.float32,
     )
     return safe_m, l, o
 
@@ -117,6 +126,33 @@ def ring_attention(
     return out.reshape(Tq, H, q.shape[-1])
 
 
+def sp_sharded_attention(
+    q: jax.Array,  # [Tq, H, hd] query rows (replicated across the axis)
+    k_local: jax.Array,  # [Sl, K, hd] local KV-cache slice (sequence-sharded)
+    v_local: jax.Array,  # [Sl, K, hd]
+    q_pos: jax.Array,  # [Tq] absolute positions (each attends s <= its pos)
+    axis_name: str,
+) -> jax.Array:
+    """Attention of Tq query rows over a sequence-sharded KV cache. Every
+    device computes partials over its slice; one pmax + two psums merge
+    them (cross-device online-softmax merge). Returns [Tq, H, hd]
+    (replicated). Tq==1 is the decode step; Tq>1 is the chunked mid-context
+    prefill."""
+    idx = jax.lax.axis_index(axis_name)
+    Sl, K, hd = k_local.shape
+    Tq, H = q.shape[0], q.shape[1]
+    kv_mul = H // K
+    qg = q.reshape(Tq, K, kv_mul, hd).astype(jnp.float32)
+    positions = idx * Sl + jnp.arange(Sl)
+    m, l, o = _chunk_attention(qg, k_local, v_local, q_pos, positions)
+    g_m = jax.lax.pmax(m, axis_name)
+    scale = jnp.exp(m - g_m)
+    g_l = jax.lax.psum(l * scale, axis_name)
+    g_o = jax.lax.psum(o * scale[..., None], axis_name)
+    out = g_o / jnp.maximum(g_l, 1e-30)[..., None]
+    return out.reshape(Tq, H, hd)
+
+
 def sp_decode_attention(
     q: jax.Array,  # [H, hd] the single decode query (replicated)
     k_local: jax.Array,  # [Sl, K, hd] local KV-cache slice (sequence-sharded)
@@ -124,24 +160,11 @@ def sp_decode_attention(
     pos: jax.Array,  # scalar: current absolute position (attend s <= pos)
     axis_name: str,
 ) -> jax.Array:
-    """One-token attention over a sequence-sharded KV cache. Every device
-    computes partials over its slice; one pmax + two psums merge them.
-    Returns [H, hd] (replicated)."""
-    idx = jax.lax.axis_index(axis_name)
-    Sl, K, hd = k_local.shape
-    H = q.shape[0]
-    kv_mul = H // K
-    qg = q.reshape(1, K, kv_mul, hd).astype(jnp.float32)
-    positions = idx * Sl + jnp.arange(Sl)
-    q_pos = jnp.asarray([pos])
-    m, l, o = _chunk_attention(qg, k_local, v_local, q_pos, positions)
-    # cross-device online-softmax merge
-    g_m = jax.lax.pmax(m, axis_name)
-    scale = jnp.exp(m - g_m)
-    g_l = jax.lax.psum(l * scale, axis_name)
-    g_o = jax.lax.psum(o * scale[..., None], axis_name)
-    out = g_o / jnp.maximum(g_l, 1e-30)[..., None]
-    return out.reshape(H, hd)
+    """One-token attention over a sequence-sharded KV cache: the Tq==1 case
+    of :func:`sp_sharded_attention`. Returns [H, hd] (replicated)."""
+    return sp_sharded_attention(
+        q[None], k_local, v_local, jnp.asarray([pos]), axis_name
+    )[0]
 
 
 # ---------------------------------------------------------------------------
@@ -225,8 +248,15 @@ class SequenceParallelForward:
         self._tp_axis = "tp" if tp > 1 else None
         self._decode_cache: dict = {}
         # the engine must not bucket-pad mid-context prompts for this
-        # backend: they are consumed stepwise, one dispatch per token
+        # backend: it chunks them itself (fixed-size masked-scatter passes,
+        # see _sp_chunk_forward) so only one program shape compiles
         self.prefers_exact_mid_prefill = True
+        # chunk width of the mid-context prefill: one dispatch consumes up
+        # to this many tokens (padded to exactly this many)
+        self.mid_prefill_chunk = 32
+        # dispatches issued by the most recent forward() call — the engine
+        # scales its measured per-dispatch transfer estimate by this
+        self.last_forward_dispatches = 1
 
         prefill = shard_map(
             functools.partial(_sp_prefill, cfg, self._tp_axis),
@@ -245,6 +275,15 @@ class SequenceParallelForward:
             check_vma=False,
         )
         self._step = jax.jit(step, donate_argnums=(2,))
+
+        chunk_fwd = shard_map(
+            functools.partial(_sp_chunk_forward, cfg, self._tp_axis),
+            mesh=self.mesh,
+            in_specs=(self._pspecs, P(), self._cache_spec, P()),
+            out_specs=(P(), self._cache_spec),
+            check_vma=False,
+        )
+        self._chunk_fwd = jax.jit(chunk_fwd, donate_argnums=(2,))
 
     # -- engine interface ---------------------------------------------------
 
@@ -274,19 +313,32 @@ class SequenceParallelForward:
         the ring-attention full-context prefill (tokens padded to seq_len —
         every device owns exactly its cache slice's positions). A multi-token
         forward at pos > 0 (a chat/API delta prompt against a live cache)
-        falls back to stepwise decode-path consumption: correct, one
-        dispatch per token — sp optimizes the long FIRST prefill."""
+        runs chunked: ceil(T/mid_prefill_chunk) fixed-width masked-scatter
+        dispatches (see _sp_chunk_forward) instead of one dispatch per
+        token, so sp serving stays usable for multi-turn chat."""
         tokens = jnp.asarray(tokens)
         T = tokens.shape[0]
+        self.last_forward_dispatches = 1
         if T == 1:
             return self._step(params, tokens, cache, jnp.asarray(pos))
         if int(pos) != 0:
+            CH = self.mid_prefill_chunk
             rows = []
-            for i in range(T):
-                row, cache = self._step(
-                    params, tokens[i : i + 1], cache, jnp.asarray(int(pos) + i)
+            p = int(pos)
+            for i in range(0, T, CH):
+                chunk = tokens[i : i + CH]
+                c = chunk.shape[0]
+                if c < CH:
+                    # pad to the one compiled width; pad rows write stale
+                    # cache slots beyond pos+T, unreachable per the engine's
+                    # rollback contract (overwritten before pos crosses them)
+                    chunk = jnp.pad(chunk, (0, CH - c))
+                logits, cache = self._chunk_fwd(
+                    params, chunk, cache, jnp.asarray(p)
                 )
-                rows.append(row)
+                rows.append(logits[:c])
+                p += c
+            self.last_forward_dispatches = (T + CH - 1) // CH
             return jnp.concatenate(rows, axis=0), cache
         S = self.cfg.seq_len
         if T != S:
@@ -452,6 +504,55 @@ def _sp_prefill(cfg, tp_axis, params, tokens_local, cache):
         att = ring_attention(
             q.astype(jnp.float32), k, v, "sp", chunk_offset=offset
         ).reshape(Tl, H * cfg.head_size)
+        x = llama.block_tail(cfg, x, att, lp, tp_axis)
+
+    return _sp_logits(cfg, tp_axis, params, x), new_cache
+
+
+def _sp_chunk_forward(cfg, tp_axis, params, tokens, cache, pos):
+    """Per-shard mid-context chunk forward: C tokens at global positions
+    pos..pos+C-1 against the LIVE sequence-sharded cache (a chat/API delta
+    prompt). Compute is replicated across ``sp`` except attention:
+
+    * each shard masked-scatters the chunk's new K/V rows into its own cache
+      slice (rows owned by other shards — or pad rows past seq_len — drop
+      via an out-of-bounds sentinel index),
+    * then attends the C queries over its updated local slice and merges
+      partials across the ring with the same pmax/psum online-softmax merge
+      as :func:`sp_decode_attention` (generalized to C query rows).
+
+    One dispatch consumes C tokens — replacing the one-dispatch-per-token
+    fallback that made ``--sp`` unusable for multi-turn chat."""
+    from distributed_llama_tpu.models import llama
+
+    idx = jax.lax.axis_index("sp")
+    C = tokens.shape[0]
+    hd = cfg.head_size
+    x = llama.embed(cfg, params, tokens)  # [C, dim]
+    gpos = pos + jnp.arange(C)
+    # gather (not dynamic_slice): a padded chunk near the context limit would
+    # clamp a slice's START and shift every real token's rope row
+    rope_rows = jnp.take(
+        params["rope_table"], jnp.clip(gpos, 0, cfg.seq_len - 1), axis=0
+    )
+
+    new_cache = []
+    for lp, cache_l in zip(params["layers"], cache):
+        Sl = cache_l[0].shape[0]
+        q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
+        H, K = q.shape[1], k.shape[1]
+        cdt = cache_l[0].dtype
+
+        local = gpos - idx * Sl
+        in_range = (local >= 0) & (local < Sl)
+        slot = jnp.where(in_range, local, Sl)  # Sl is out of bounds -> drop
+        keys = cache_l[0].at[slot].set(k.astype(cdt), mode="drop")
+        values = cache_l[1].at[slot].set(v.astype(cdt), mode="drop")
+        new_cache.append((keys, values))
+
+        att = sp_sharded_attention(
+            q.astype(jnp.float32), keys, values, gpos, "sp"
+        ).reshape(C, H * hd)
         x = llama.block_tail(cfg, x, att, lp, tp_axis)
 
     return _sp_logits(cfg, tp_axis, params, x), new_cache

@@ -181,8 +181,8 @@ class TestSequenceParallelEngine:
 
     def test_sp_mid_context_prefill_matches_dense(self, tmp_path):
         """Chat/API delta prompts prefill at pos > 0 against the live cache;
-        sp consumes them via the stepwise decode path — slower but correct
-        (the chat REPL and API server share the --sp flag)."""
+        sp consumes them in chunked masked-scatter dispatches (the chat REPL
+        and API server share the --sp flag)."""
         from distributed_llama_tpu.engine import InferenceEngine
 
         path = self._model(tmp_path)
@@ -194,6 +194,53 @@ class TestSequenceParallelEngine:
         esp.prefill([1, 2, 3])
         got = esp.forward([4, 5, 6])
         assert esp.pos == dense.pos == 6
+        # one chunk-wide dispatch, not one per token
+        assert esp._tp_engine.last_forward_dispatches == 1
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+    def test_sp_mid_context_prefill_multi_chunk(self, tmp_path):
+        """A delta prompt wider than the chunk runs in ceil(T/chunk)
+        dispatches and still matches the dense path, including decode
+        continuing correctly off the updated cache."""
+        from distributed_llama_tpu.engine import InferenceEngine
+
+        path = self._model(tmp_path)
+        delta = [4, 5, 6, 7, 8, 9, 10]
+        dense = InferenceEngine(path, dtype=jnp.float32)
+        dense.prefill([1, 2, 3])
+        want = dense.forward(delta)
+        want_stream = dense.generate_on_device(11, 6, temperature=0.0).tolist()
+
+        esp = InferenceEngine(path, dtype=jnp.float32, sp=4)
+        esp._tp_engine.mid_prefill_chunk = 4  # force 2 chunks for T=7
+        esp.prefill([1, 2, 3])
+        got = esp.forward(delta)
+        assert esp._tp_engine.last_forward_dispatches == 2
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+        got_stream = esp.generate_on_device(11, 6, temperature=0.0).tolist()
+        assert got_stream == want_stream
+        # the transfer estimate is scaled by the dispatch count: the
+        # mid-prefill entry charges 2 dispatches' worth of collectives
+        assert esp.stats[-7].n_tokens == 7
+
+    def test_sp_mid_context_prefill_at_context_limit(self, tmp_path):
+        """A delta prompt whose padded chunk would cross seq_len: pad rows
+        past the context drop via the scatter's out-of-bounds sentinel and
+        real tokens keep their true rope rows (a clamped dynamic_slice would
+        shift them)."""
+        from distributed_llama_tpu.engine import InferenceEngine
+
+        path = self._model(tmp_path)  # seq_len 32
+        head = list(range(1, 29))  # pos 0..27
+        dense = InferenceEngine(path, dtype=jnp.float32)
+        dense.prefill(head)
+        want = dense.forward([30, 31, 32])  # pos 28..30; chunk pads to 31..
+
+        esp = InferenceEngine(path, dtype=jnp.float32, sp=4)
+        esp._tp_engine.mid_prefill_chunk = 8  # pads 28..35, 32+ dropped
+        esp.prefill(head)
+        got = esp.forward([30, 31, 32])
+        assert esp.pos == dense.pos == 31
         np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
 
 
@@ -253,6 +300,29 @@ class TestTpSpMesh:
         }
         # seq 32/sp4 = 8 slots, kv heads 4/tp2 = 2 per shard
         assert shard_shapes == {(8, 2, 16)}
+
+    def test_tpsp_mid_context_prefill_matches_dense(self, tmp_path):
+        """The chunked mid-context prefill on the 2-D (tp, sp) mesh: the
+        scatter runs against [Sl, K/tp, hd] cache slices with H/tp query
+        heads and the tp vocab all-gather — none of which the sp-only tests
+        exercise."""
+        from distributed_llama_tpu.engine import InferenceEngine
+
+        path = self._model(tmp_path)
+        delta = [4, 5, 6, 7, 8]
+        dense = InferenceEngine(path, dtype=jnp.float32)
+        dense.prefill([1, 2, 3])
+        want = dense.forward(delta)
+        want_stream = dense.generate_on_device(9, 5, temperature=0.0).tolist()
+
+        e = InferenceEngine(path, dtype=jnp.float32, tp=2, sp=2)
+        e._tp_engine.mid_prefill_chunk = 4  # 2 chunks for T=5
+        e.prefill([1, 2, 3])
+        got = e.forward(delta)
+        assert e._tp_engine.last_forward_dispatches == 2
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+        got_stream = e.generate_on_device(9, 5, temperature=0.0).tolist()
+        assert got_stream == want_stream
 
     def test_tpsp_q40_greedy_stream(self, tmp_path):
         """The production format on the 2-D mesh: Q40 sharded packs through

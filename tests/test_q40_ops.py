@@ -86,3 +86,21 @@ class TestMatmul:
         # quantization noise, not kernel error
         rel = np.abs(got - exact).max() / np.abs(exact).max()
         assert rel < 0.12, rel
+
+
+class TestEnvTileValidation:
+    def test_bad_env_tile_fails_at_kernel_use_not_import(self, monkeypatch):
+        """A bad DLT_BN value must not make the package unimportable
+        (--help and unrelated subcommands keep working); the error surfaces
+        when the kernel is actually configured, naming the knob."""
+        from distributed_llama_tpu.ops import q40 as q40mod
+
+        monkeypatch.setattr(q40mod, "BLOCK_N", 300)  # not a multiple of 512
+        rng = np.random.RandomState(0)
+        # T=3 keeps the jit signature unique to this test: the validation
+        # runs at trace time, so a shape another test already traced would
+        # hit the cache and never observe the patched value
+        qm = quantize_q40_tpu(rng.randn(512, 128).astype(np.float32))
+        x = jnp.asarray(rng.randn(3, 512).astype(np.float32))
+        with pytest.raises(ValueError, match="DLT_BN=300"):
+            q40_matmul(x, qm, interpret=True)
