@@ -156,53 +156,64 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     prefill_warm_ms = sorted(warm_times)[1]
 
     token = jnp.int32(np.argmax(np.asarray(logits[-1])))
-    pos = 4 * prefill_len
+    single_base = 4 * prefill_len  # fixed window: decode_loop replays 256..384
+    chunk_base = single_base + steps  # chunked replays 384..512
 
     # warmup: n_steps is a static argument, so the warm call must use the
     # SAME step count as the measured call or XLA compiles inside the timing
     import jax.random
 
-    warm, cache = decode_loop(cfg, params, token, cache, jnp.int32(pos), steps, 0.0, 0.9,
-                              jax.random.PRNGKey(0))
-    np.asarray(warm)
-    pos += steps
-    token = warm[-1]
-
-    # measured: greedy decode entirely on device, one dispatch
-    t0 = time.perf_counter()
-    tokens, cache = decode_loop(cfg, params, token, cache, jnp.int32(pos), steps, 0.0, 0.9,
-                                jax.random.PRNGKey(1))
-    np.asarray(tokens)
-    elapsed = time.perf_counter() - t0
-    tps = steps / elapsed
-    pos += steps
-
-    # user path: the chunked streaming decode the CLI/API actually run
-    # (decode_chunk per 32 tokens, host stop-handling between dispatches)
     from distributed_llama_tpu.models.sampling import decode_chunk
 
+    warm, cache = decode_loop(cfg, params, token, cache, jnp.int32(single_base), steps,
+                              0.0, 0.9, jax.random.PRNGKey(0))
+    np.asarray(warm)
+    token = warm[-1]
     chunk = 32
-    tok_j = tokens[-1]
     key = jax.random.PRNGKey(2)
-    toks, cache, key = decode_chunk(cfg, params, tok_j, cache, jnp.int32(pos), chunk,
+    toks, cache, key = decode_chunk(cfg, params, token, cache, jnp.int32(chunk_base), chunk,
                                     jnp.float32(0.0), jnp.float32(0.9), key)  # warm/compile
     np.asarray(toks)
-    pos += chunk
+
+    # single-dispatch and chunked (user-path) decode, INTERLEAVED with
+    # median-of-3: the shared/tunneled chip drifts 15-25% on minute scales,
+    # so sequential sections would compare different tenancy regimes, not
+    # different code paths (the round-3 "26% chunk gap" was largely that).
+    # Every rep replays the same fixed position windows — identical
+    # executables and identical work; the KV contents are random-weight
+    # garbage either way.
     n_chunks = 4
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        # pipelined like engine.generate_chunks: dispatch the next chunk off
-        # the device-resident last token BEFORE fetching the previous one
-        nxt, cache, key = decode_chunk(cfg, params, toks[-1], cache, jnp.int32(pos), chunk,
-                                       jnp.float32(0.0), jnp.float32(0.9), key)
-        np.asarray(toks)  # host consumption overlaps the next chunk's compute
-        toks = nxt
-        pos += chunk
-    np.asarray(toks)  # the last dispatched chunk must finish inside the window
-    user_tps = n_chunks * chunk / (time.perf_counter() - t0)
+    single_runs, user_runs = [], []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        tokens, cache = decode_loop(cfg, params, token, cache, jnp.int32(single_base),
+                                    steps, 0.0, 0.9, jax.random.PRNGKey(1))
+        np.asarray(tokens)
+        single_runs.append(steps / (time.perf_counter() - t0))
+
+        pos = chunk_base
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            # pipelined like engine.generate_chunks: dispatch the next chunk
+            # off the device-resident last token, start the previous chunk's
+            # host copy, then block on it — fetch overlaps compute
+            nxt, cache, key = decode_chunk(cfg, params, toks[-1], cache, jnp.int32(pos),
+                                           chunk, jnp.float32(0.0), jnp.float32(0.9), key)
+            try:
+                toks.copy_to_host_async()
+            except Exception:
+                pass
+            np.asarray(toks)
+            toks = nxt
+            pos += chunk
+        np.asarray(toks)  # the last dispatched chunk must finish in-window
+        user_runs.append(n_chunks * chunk / (time.perf_counter() - t0))
+    tps = sorted(single_runs)[1]
+    user_tps = sorted(user_runs)[1]
 
     # secondary: host-sampled stepwise decode (the reference's exact regime,
     # pays a host<->device round trip per token); warm the 1-token shape first
+    pos = chunk_base + n_chunks * chunk
     tok = int(np.asarray(tokens[-1]))
     logits, cache = fwd(cfg, params, jnp.asarray([tok], jnp.int32), cache, jnp.int32(pos))
     tok = int(np.argmax(np.asarray(logits[0])))
@@ -237,7 +248,8 @@ def main():
     import jax
 
     device = jax.devices()[0]
-    seq_len = 768  # position budget: 4x64 prefill + 2x128 decode + 5x32 chunks + 17 stepwise
+    seq_len = 768  # position budget: 4x64 prefill + 128-wide decode window +
+    # 128-wide chunk window (both replayed per rep) + 17 stepwise = 529
     # PRIMARY metric: Q40 — the reference's own headline weight format, so
     # vs_baseline is an apples-to-apples Q40-vs-Q40 comparison (round-2
     # verdict: the format comparison must be the primary number, not a
@@ -296,6 +308,12 @@ def main_single(weights: str):
 
 
 if __name__ == "__main__":
+    # the cold-prefill metric measures what a fresh process pays: with the
+    # persistent cache populated by a previous run, that is cache
+    # deserialization, not a full XLA compile
+    from distributed_llama_tpu.platform import enable_compilation_cache
+
+    enable_compilation_cache()
     if "--q40-only" in sys.argv:
         main_single("q40")
     elif "--bf16-only" in sys.argv:

@@ -105,9 +105,11 @@ class InferenceEngine:
             )
         else:
             self._tp_engine = None
-        mesh = self._tp_engine.mesh if (tp > 1 and quantized) else None
+        # every dtype loads per-shard under tp: each process reads only its
+        # own shards' bytes and places them straight onto its devices
+        mesh = self._tp_engine.mesh if tp > 1 else None
         host_params = weights_lib.load_params(
-            reader, self.cfg, dtype=dtype, tp=tp if quantized else 1, mesh=mesh
+            reader, self.cfg, dtype=dtype, tp=tp, mesh=mesh
         )
         reader.close()
         if self._tp_engine is not None:
@@ -321,7 +323,7 @@ class InferenceEngine:
         temperature: float = 0.0,
         topp: float = 0.9,
         seed: int = 0,
-        chunk: int = 16,
+        chunk: int = 32,
         limit: int | None = None,
     ):
         """Generator of on-device-decoded tokens: ``chunk`` tokens per device
@@ -366,6 +368,13 @@ class InferenceEngine:
                 nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
             else:
                 nxt, k = None, 0
+            try:
+                # start the device->host copy without blocking: behind a
+                # remote PJRT tunnel the blocking fetch pays a full round
+                # trip; enqueued here it overlaps the next chunk's compute
+                pending.copy_to_host_async()
+            except Exception:
+                pass  # optional acceleration; np.asarray below is the contract
             toks = np.asarray(pending)
             elapsed_ms = (time.perf_counter() - start) * 1000.0
             self.stats.extend([self._split_stats(elapsed_ms / pending_n)] * pending_n)
@@ -382,7 +391,7 @@ class InferenceEngine:
         temperature: float = 0.0,
         topp: float = 0.9,
         seed: int = 0,
-        chunk: int = 16,
+        chunk: int = 32,
         limit: int | None = None,
     ) -> int:
         """Drive the chunked fast decode with host-side stop handling: the

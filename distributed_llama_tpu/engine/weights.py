@@ -46,27 +46,25 @@ def load_params(
     (QuantizedMatrix leaves, fed to the fused Pallas matmul), including the
     MoE expert banks (per-expert fused gate|up + down leaves).
 
-    ``tp > 1`` (q40 only) builds every quantized matrix as per-shard packs in
-    sharded layout: each shard's slice is READ from the file independently
-    (raw_rows / raw_row_blocks — the read-time equivalent of the reference's
-    RowMatmulSlice/ColMatmulSlice scatter, src/commands.cpp:11-108 +
-    src/transformer.cpp:432-451). With ``mesh`` set, the packs are placed
-    via ``jax.make_array_from_callback``: each PROCESS builds (and reads)
-    only the shards of its addressable devices — per-host RAM and file
-    traffic are O(model/tp), the property that makes a 238 GB 405B file
-    loadable across a pod. Without a mesh they are concatenated on host for
-    a later NamedSharding device_put (single-host fallback).
+    ``tp > 1`` builds every matmul weight as per-shard reads in sharded
+    layout — q40 as per-shard packs (raw_rows / raw_row_blocks), bf16/f32
+    via row/column-range reads (tensor_rows / tensor_cols) — the read-time
+    equivalent of the reference's RowMatmulSlice/ColMatmulSlice scatter
+    (src/commands.cpp:11-108 + src/transformer.cpp:432-451). With ``mesh``
+    set, shards are placed via ``jax.make_array_from_callback``: each
+    PROCESS builds (and reads) only the shards of its addressable devices —
+    per-host RAM and file traffic are O(model/tp), the property that makes
+    a 238 GB 405B file loadable across a pod. Without a mesh they are
+    concatenated on host for a later NamedSharding device_put (single-host
+    fallback).
     """
     spec = reader.spec
     cfg = cfg or config_from_spec(spec)
     quantized = dtype == QUANTIZED_DTYPE
-    if tp > 1 and not quantized:
-        raise ValueError("load_params(tp>1) is the q40 sharded-pack path; "
-                         "bf16/f32 weights shard via device_put in the engine")
     if tp > 1:
         from distributed_llama_tpu.parallel.tensor_parallel import validate_tp
 
-        validate_tp(cfg, tp, quantized=True)
+        validate_tp(cfg, tp, quantized=quantized)
     np_dtype = np.dtype(jnp.bfloat16 if quantized else dtype)
 
     def cast(x: np.ndarray) -> np.ndarray:
@@ -200,6 +198,82 @@ def load_params(
         built.clear()  # free host copies; the data lives on device now
         return QuantizedMatrix(qs_g, sc_g, n_logical=n_shard, d_logical=d_shard)
 
+    def _read_shard(name: str, axis: str, s: int) -> np.ndarray:
+        """Shard ``s`` of one file matrix in logical (x@W) orientation: an
+        independent row-range (out) or column-range (in) read."""
+        e = reader.entries[name]
+        d_out, d_in = e.shape  # file orientation; logical is [d_in, d_out]
+        if axis == "out":
+            lo, hi = d_out * s // tp, d_out * (s + 1) // tp
+            return reader.tensor_rows(name, lo, hi).T
+        lo, hi = d_in * s // tp, d_in * (s + 1) // tp
+        return reader.tensor_cols(name, lo, hi).T
+
+    def _place_shards(gshape, ax: int, spec, build):
+        """Shared placement scaffold of the plain sharded loads: with a mesh,
+        each PROCESS builds (reads) only its addressable devices' shards via
+        make_array_from_callback; without one, shards concatenate on host
+        for a later NamedSharding device_put."""
+        import jax.sharding as shd
+
+        built: dict[int, np.ndarray] = {}
+
+        def cached(s: int) -> np.ndarray:
+            if s not in built:
+                built[s] = build(s)
+            return built[s]
+
+        if mesh is None:
+            out = np.concatenate([cached(s) for s in range(tp)], axis=ax)
+            built.clear()
+            return out
+        shard_len = gshape[ax] // tp
+
+        def cb(idx):
+            return cached((idx[ax].start or 0) // shard_len)
+
+        arr = jax.make_array_from_callback(
+            gshape, shd.NamedSharding(mesh, spec), cb
+        )
+        built.clear()
+        return arr
+
+    def sharded_plain(name: str, axis: str):
+        """Per-shard lazy read of a bf16/f32 matmul weight: the non-quantized
+        analogue of ``sharded()`` (reader.tensor_rows / tensor_cols range
+        reads) — O(model/tp) file traffic per host for every dtype, not just
+        q40 (replacing the reference's root-reads-everything scatter for
+        bf16 as well, src/transformer.cpp:432-451)."""
+        import jax.sharding as shd
+
+        d_out, d_in = reader.entries[name].shape
+        ax = 1 if axis == "out" else 0
+        spec = shd.PartitionSpec(None, "tp") if axis == "out" else shd.PartitionSpec("tp", None)
+        return _place_shards(
+            (d_in, d_out), ax, spec,
+            lambda s: np.ascontiguousarray(_read_shard(name, axis, s)).astype(np_dtype),
+        )
+
+    def sharded_plain_expert_stack(expert_names: list[str], axis: str):
+        """Sharded read of a stacked MoE expert bank: [E, d_in, d_out] with
+        the matmul dim sharded (moe_up/gate: out; moe_down: in). Each shard
+        stacks its per-expert row/column-range reads."""
+        import jax.sharding as shd
+
+        d_out, d_in = reader.entries[expert_names[0]].shape
+        ax = 2 if axis == "out" else 1
+        spec = (
+            shd.PartitionSpec(None, None, "tp")
+            if axis == "out"
+            else shd.PartitionSpec(None, "tp", None)
+        )
+        return _place_shards(
+            (len(expert_names), d_in, d_out), ax, spec,
+            lambda s: np.ascontiguousarray(
+                np.stack([_read_shard(nm, axis, s) for nm in expert_names])
+            ).astype(np_dtype),
+        )
+
     layers: dict[str, list] = {}
 
     def add(key: str, value) -> None:
@@ -213,6 +287,11 @@ def load_params(
         elif quantized:
             add("qkv", weight_fused([p + "q", p + "k", p + "v"]))
             add("wo", weight(p + "wo"))
+        elif tp > 1:
+            add("q", sharded_plain(p + "q", "out"))
+            add("k", sharded_plain(p + "k", "out"))
+            add("v", sharded_plain(p + "v", "out"))
+            add("wo", sharded_plain(p + "wo", "in"))
         else:
             add("q", weight(p + "q"))
             add("k", weight(p + "k"))
@@ -240,6 +319,12 @@ def load_params(
                         "down": weight(ep + "down"),
                     })
             add("experts", experts)
+        elif cfg.is_moe and tp > 1:
+            add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
+            enames = [f"{p}experts.{e}." for e in range(cfg.n_experts)]
+            add("moe_up", sharded_plain_expert_stack([n + "up" for n in enames], "out"))
+            add("moe_gate", sharded_plain_expert_stack([n + "gate" for n in enames], "out"))
+            add("moe_down", sharded_plain_expert_stack([n + "down" for n in enames], "in"))
         elif cfg.is_moe:
             add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
             ups, gates, downs = [], [], []
@@ -257,6 +342,10 @@ def load_params(
         elif quantized:
             add("gate_up", weight_fused([p + "gate", p + "up"]))
             add("down", weight(p + "down"))
+        elif tp > 1:
+            add("gate", sharded_plain(p + "gate", "out"))
+            add("down", sharded_plain(p + "down", "in"))
+            add("up", sharded_plain(p + "up", "out"))
         else:
             add("gate", weight(p + "gate"))
             add("down", weight(p + "down"))
@@ -276,6 +365,8 @@ def load_params(
     ]
     if quantized and tp > 1 and cfg.vocab_size % tp == 0:
         wcls = sharded(shard_out, ["wcls"])  # vocab-sharded logits head
+    elif tp > 1 and cfg.vocab_size % tp == 0:
+        wcls = sharded_plain("wcls", "out")
     else:
         wcls = weight("wcls")
     return {

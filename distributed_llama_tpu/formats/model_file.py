@@ -420,6 +420,36 @@ class ModelFileReader:
         flat = deserialize_tensor(buf, e.float_type, nrows * n)
         return flat.reshape(nrows, n)
 
+    def tensor_cols(self, name: str, col_start: int, col_end: int) -> np.ndarray:
+        """Read a column (input-dim) range of every row — the input-sharded
+        analogue of :meth:`tensor_rows` (ColMatmulSlice applied at read
+        time). Works for every on-disk dtype: block formats (Q40/Q80) slice
+        on quant-block boundaries via :meth:`raw_row_blocks` when the range
+        is aligned, else fall back to decoding whole rows (correct, just
+        full-row file traffic — counted honestly in ``bytes_read``).
+        Returns f32 [d_out, cols]."""
+        from distributed_llama_tpu.quants import QK
+
+        e = self.entries[name]
+        if len(e.shape) != 2:
+            raise ValueError(f"tensor_cols on non-matrix {name}")
+        d_out, d_in = e.shape
+        ncols = col_end - col_start
+        if e.float_type in (FloatType.Q40, FloatType.Q80):
+            if col_start % QK == 0 and col_end % QK == 0:
+                buf = self.raw_row_blocks(name, col_start, col_end)
+                flat = deserialize_tensor(buf.reshape(-1), e.float_type, d_out * ncols)
+                return flat.reshape(d_out, ncols)
+            return self.tensor(name)[:, col_start:col_end]
+        row_bytes = tensor_bytes(e.float_type, d_in)
+        lo = tensor_bytes(e.float_type, col_start)
+        hi = tensor_bytes(e.float_type, col_end)
+        rows = self._mmap[e.offset : e.offset + e.nbytes].reshape(d_out, row_bytes)
+        buf = np.ascontiguousarray(rows[:, lo:hi])
+        self.bytes_read += buf.nbytes
+        flat = deserialize_tensor(buf.reshape(-1), e.float_type, d_out * ncols)
+        return flat.reshape(d_out, ncols)
+
     def close(self):
         del self._mmap
 

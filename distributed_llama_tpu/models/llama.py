@@ -174,17 +174,16 @@ def attention(
     (reference: src/llama2-tasks.cpp:33-108) with the per-timestep score loop
     replaced by one masked einsum over the whole cache.
     """
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
     T = x.shape[0]
     S = cache_l[0].shape[0]  # works for tuple (keys, values) and stacked [2, S, ...] forms
     hd = cfg.head_size
     q, k, v = project_qkv(cfg, lp, x, rope_rows)
     Hl, Kl = q.shape[1], k.shape[1]
 
-    cache_dtype = cache_l[0].dtype
-    keys = jax.lax.dynamic_update_slice(
-        cache_l[0], k.astype(cache_dtype), (pos, 0, 0)
-    )  # [S, Kl, hd]
-    values = jax.lax.dynamic_update_slice(cache_l[1], v.astype(cache_dtype), (pos, 0, 0))
+    keys = kvc.update_rows(cache_l[0], k, pos)  # [S, Kl, hd]
+    values = kvc.update_rows(cache_l[1], v, pos)
     # per-layer TUPLE caches (the layered layout) update in place; stacking
     # into a [2, S, Kl, hd] array would copy the layer's ENTIRE cache every
     # step (~1.3 ms/token across 32 layers of a 7B, profiled) because XLA
@@ -192,28 +191,23 @@ def attention(
     new_cache = (keys, values) if isinstance(cache_l, tuple) else jnp.stack([keys, values])
 
     kv_mul = Hl // Kl
-    # score/value einsums run with operands in the CACHE dtype and f32
-    # accumulation: casting a bf16 cache to f32 first would materialize 2x
-    # the cache bytes per layer per token (the attention reads are the
+    # score/value einsums run with operands in the CACHE dtype (bf16 for an
+    # i8 cache — the HBM reads stay int8/bf16 either way) and f32
+    # accumulation: casting a narrow cache to f32 first would materialize
+    # 2-4x the cache bytes per layer per token (the attention reads are the
     # second-largest HBM stream after the weights). f32 caches (parity
     # tests) keep true-f32 multiplies via HIGHEST.
-    cdt = keys.dtype
-    prec = jax.lax.Precision.HIGHEST if cdt == jnp.float32 else None
+    cdt = kvc.compute_dtype(keys)
+    prec = kvc.einsum_precision(keys)
     qg = q.reshape(T, Kl, kv_mul, hd).astype(cdt)
-    scores = jnp.einsum(
-        "tkmh,skh->tkms", qg, keys, precision=prec,
-        preferred_element_type=jnp.float32,
-    ) / jnp.sqrt(jnp.float32(hd))
+    scores = kvc.scores_einsum(qg, keys, prec) / jnp.sqrt(jnp.float32(hd))
     # causal mask: query t (absolute pos+t) sees cache slots 0..pos+t
     t_idx = pos + jnp.arange(T)[:, None]
     s_idx = jnp.arange(S)[None, :]
     mask = s_idx <= t_idx  # [T, S]
     scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
-    att = jnp.einsum(
-        "tkms,skh->tkmh", weights.astype(cdt), values, precision=prec,
-        preferred_element_type=jnp.float32,
-    ).reshape(T, Hl * hd)
+    att = kvc.mix_einsum(weights, values, cdt, prec).reshape(T, Hl * hd)
     return att, new_cache
 
 
@@ -308,12 +302,18 @@ def init_cache(
     ``layered=True`` returns a list of per-layer ``(keys, values)`` tuples
     of [S, Kl, hd] arrays — the form the unrolled forward needs so in-place
     cache updates alias per leaf instead of copying the whole cache each
-    step (see attention)."""
+    step (see attention). ``dtype="i8"`` builds a quantized cache
+    (:class:`distributed_llama_tpu.ops.kv_cache.QuantizedKV` halves — half
+    the HBM of bf16; layered only)."""
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
     kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
     shape = (cfg.seq_len, kl, cfg.head_size)
+    if kvc.is_quantized_cache_dtype(dtype) and not layered:
+        raise ValueError("the i8 KV cache requires the layered cache layout")
     if layered:
         return [
-            (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+            (kvc.init_half(shape, dtype), kvc.init_half(shape, dtype))
             for _ in range(cfg.n_layers)
         ]
     return jnp.zeros((cfg.n_layers, 2) + shape, dtype=dtype)

@@ -28,6 +28,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from distributed_llama_tpu.ops import kv_cache as kvc
+
 
 def _chunk_attention(
     q: jax.Array,  # [Tq, K, M, hd] f32 (grouped: K kv-heads × M q-per-kv)
@@ -45,15 +47,13 @@ def _chunk_attention(
     token (the same fix as llama.attention's score/value einsums).
     """
     hd = q.shape[-1]
-    cdt = k.dtype
-    # f32 caches (parity tests) keep true-f32 multiplies, mirroring
-    # llama.attention — otherwise TPU's default bf16 demotion makes f32 SP
-    # runs diverge from the dense f32 path
-    prec = jax.lax.Precision.HIGHEST if cdt == jnp.float32 else None
-    scores = jnp.einsum(
-        "tkmh,skh->tkms", q.astype(cdt), k, precision=prec,
-        preferred_element_type=jnp.float32,
-    ) / jnp.sqrt(jnp.float32(hd))
+    # compute dtype follows the cache half (bf16 for an i8 half); f32 caches
+    # (parity tests) keep true-f32 multiplies, mirroring llama.attention —
+    # otherwise TPU's default bf16 demotion makes f32 SP runs diverge from
+    # the dense f32 path
+    cdt = kvc.compute_dtype(k)
+    prec = kvc.einsum_precision(k)
+    scores = kvc.scores_einsum(q.astype(cdt), k, prec) / jnp.sqrt(jnp.float32(hd))
     mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)  # [Tq, K, M]
@@ -62,10 +62,7 @@ def _chunk_attention(
     p = jnp.exp(scores - safe_m[..., None])
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum(
-        "tkms,skh->tkmh", p.astype(cdt), v, precision=prec,
-        preferred_element_type=jnp.float32,
-    )
+    o = kvc.mix_einsum(p, v, cdt, prec)
     return safe_m, l, o
 
 
@@ -298,15 +295,20 @@ class SequenceParallelForward:
         cfg = self.cfg
         shape = (cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
         sharding = self._NamedSharding(self.mesh, self._cache_spec[0])
-        per_shard = (
-            cfg.seq_len // self.sp, cfg.n_kv_heads // self.tp, cfg.head_size
-        )
-        zeros = np.zeros(per_shard, dtype)
 
-        def arr():
-            return jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
+        def zeros(gshape, dt):
+            # gshape is GLOBAL; (sequence, kv-head) shard per device — the
+            # spec prefix covers QuantizedKV's rank-3 scales leaf too
+            local = np.zeros(
+                (gshape[0] // self.sp, gshape[1] // self.tp) + gshape[2:], dt
+            )
+            return jax.make_array_from_callback(gshape, sharding, lambda idx: local)
 
-        return [(arr(), arr()) for _ in range(cfg.n_layers)]
+        return [
+            (kvc.init_half(shape, dtype, zeros=zeros),
+             kvc.init_half(shape, dtype, zeros=zeros))
+            for _ in range(cfg.n_layers)
+        ]
 
     def forward(self, params, tokens, cache, pos):
         """Engine forward: T==1 routes to the decode step; T>1 at pos 0 is
@@ -497,10 +499,21 @@ def _sp_prefill(cfg, tp_axis, params, tokens_local, cache):
     for lp, cache_l in zip(params["layers"], cache):
         q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
         H = q.shape[1]
-        cdt = cache_l[0].dtype
-        k = k.astype(cdt)
-        v = v.astype(cdt)
-        new_cache.append((k, v))
+        if isinstance(cache_l[0], kvc.QuantizedKV):
+            # each device's fresh chunk IS its whole cache slice: store it
+            # quantized; the ring below attends the raw rows (bf16 on the
+            # wire — quantizing the ring would only trade accuracy for ICI
+            # bytes the prefill doesn't bottleneck on)
+            kq, ks = kvc.quantize_rows(k)
+            vq, vs = kvc.quantize_rows(v)
+            new_cache.append(
+                (kvc.QuantizedKV(kq, ks), kvc.QuantizedKV(vq, vs))
+            )
+        else:
+            cdt = cache_l[0].dtype
+            k = k.astype(cdt)
+            v = v.astype(cdt)
+            new_cache.append((k, v))
         att = ring_attention(
             q.astype(jnp.float32), k, v, "sp", chunk_offset=offset
         ).reshape(Tl, H * cfg.head_size)
@@ -541,13 +554,12 @@ def _sp_chunk_forward(cfg, tp_axis, params, tokens, cache, pos):
         Sl = cache_l[0].shape[0]
         q, k, v = llama.project_qkv(cfg, lp, x, rope_rows)
         H, K = q.shape[1], k.shape[1]
-        cdt = cache_l[0].dtype
 
         local = gpos - idx * Sl
         in_range = (local >= 0) & (local < Sl)
         slot = jnp.where(in_range, local, Sl)  # Sl is out of bounds -> drop
-        keys = cache_l[0].at[slot].set(k.astype(cdt), mode="drop")
-        values = cache_l[1].at[slot].set(v.astype(cdt), mode="drop")
+        keys = kvc.scatter_rows(cache_l[0], slot, k)
+        values = kvc.scatter_rows(cache_l[1], slot, v)
         new_cache.append((keys, values))
 
         att = sp_sharded_attention(
@@ -582,13 +594,8 @@ def _sp_decode_step(cfg, tp_axis, params, tokens, cache, pos):
         # row they already had back into place
         owner = (pos >= idx * Sl) & (pos < (idx + 1) * Sl)
         lpos = jnp.clip(pos - idx * Sl, 0, Sl - 1)
-        cdt = cache_l[0].dtype
-        old_k = jax.lax.dynamic_slice(cache_l[0], (lpos, 0, 0), (1, K, hd))
-        old_v = jax.lax.dynamic_slice(cache_l[1], (lpos, 0, 0), (1, K, hd))
-        k_row = jnp.where(owner, k.astype(cdt), old_k)
-        v_row = jnp.where(owner, v.astype(cdt), old_v)
-        keys = jax.lax.dynamic_update_slice(cache_l[0], k_row, (lpos, 0, 0))
-        values = jax.lax.dynamic_update_slice(cache_l[1], v_row, (lpos, 0, 0))
+        keys = kvc.select_row_update(cache_l[0], k, lpos, owner)
+        values = kvc.select_row_update(cache_l[1], v, lpos, owner)
         new_cache.append((keys, values))
 
         att = sp_decode_attention(

@@ -397,16 +397,25 @@ class TensorParallelForward:
         return elapsed_ms / n_tokens
 
     def init_cache(self, dtype=jnp.float32):
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
         kv_shape = (self.cfg.seq_len, self.cfg.n_kv_heads, self.cfg.head_size)
         if self.layered:  # per-layer (keys, values) tuples (see _cache_spec)
             sharding = NamedSharding(self.mesh, CACHE_SPEC_LAYER)
-            per_shard = (kv_shape[0], kv_shape[1] // self.tp, kv_shape[2])
-            zeros = np.zeros(per_shard, dtype)
 
-            def arr():
-                return jax.make_array_from_callback(kv_shape, sharding, lambda idx: zeros)
+            def zeros(shape, dt):
+                # shape is GLOBAL; build the local kv-head shard (the spec
+                # prefix covers QuantizedKV's rank-3 scales leaf too)
+                local = np.zeros((shape[0], shape[1] // self.tp) + shape[2:], dt)
+                return jax.make_array_from_callback(shape, sharding, lambda idx: local)
 
-            return [(arr(), arr()) for _ in range(self.cfg.n_layers)]
+            return [
+                (kvc.init_half(kv_shape, dtype, zeros=zeros),
+                 kvc.init_half(kv_shape, dtype, zeros=zeros))
+                for _ in range(self.cfg.n_layers)
+            ]
+        if kvc.is_quantized_cache_dtype(dtype):
+            raise ValueError("the i8 KV cache requires the layered cache layout")
         shape = (self.cfg.n_layers, 2) + kv_shape
         sharding = NamedSharding(self.mesh, CACHE_SPEC)
         per_shard = shape[:3] + (shape[3] // self.tp,) + shape[4:]

@@ -27,8 +27,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 import time
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_llama_tpu.tokenizer import (
     ChatItem,
@@ -43,6 +44,10 @@ from distributed_llama_tpu.tokenizer import (
 )
 
 MODEL_NAME = "Distributed Model"  # (reference: types.hpp:54, 80)
+
+
+class BadRequest(ValueError):
+    """Client error in a request body — mapped to HTTP 400 by the handler."""
 
 
 @dataclasses.dataclass
@@ -91,13 +96,26 @@ class ApiState:
         template_type = getattr(args, "chat_template", None) or ChatTemplateType.UNKNOWN
         self.template = ChatTemplate(template_type, tokenizer.chat_template, stops[0])
         self.cache = NaiveCache()
+        # one engine, one stream position: completions are strictly
+        # serialized. The reference is single-threaded by construction
+        # (dllama-api.cpp:418-423 accepts one socket at a time); here the
+        # HTTP layer is threaded (GET /v1/models answers during a live
+        # generation) so the serialization is an explicit lock.
+        self.lock = threading.Lock()
 
-    def complete(self, body: dict, send_chunk) -> dict | None:
+    def complete(self, body: dict, send_chunk, params: dict | None = None) -> dict | None:
         """Run one completion. ``send_chunk(str)`` streams SSE data lines when
         the request has stream=true (then returns None); otherwise returns the
-        final JSON payload."""
+        final JSON payload. Concurrent calls queue on the engine lock.
+        ``params``: the pre-validated result of :meth:`_parse` (the handler
+        validates before sending SSE headers, so validation runs once)."""
+        if params is None:
+            params = self._parse(body)
+        with self.lock:
+            return self._complete_locked(params, send_chunk)
+
+    def _complete_locked(self, params: dict, send_chunk) -> dict | None:
         engine, tokenizer = self.engine, self.tokenizer
-        params = self._parse(body)
         stream = params["stream"]
 
         start_pos, delta_messages = self.cache.resolve_delta_prompt(params["messages"])
@@ -266,20 +284,48 @@ class ApiState:
         return json.dumps(payload)
 
     def _parse(self, body: dict) -> dict:
+        """Validate and normalize a request body. Raises
+        :class:`BadRequest` with a client-facing message on any malformed
+        field — the handler maps it to HTTP 400 (the reference crashes its
+        handler thread on bad JSON instead, dllama-api.cpp:418-423)."""
+        if not isinstance(body, dict):
+            raise BadRequest("request body must be a JSON object")
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise BadRequest("'messages' must be a non-empty array")
+        for i, m in enumerate(messages):
+            if (
+                not isinstance(m, dict)
+                or not isinstance(m.get("role"), str)
+                or not isinstance(m.get("content"), str)
+            ):
+                raise BadRequest(
+                    f"messages[{i}] must be an object with string 'role' and 'content'"
+                )
         # OpenAI allows stop to be a string, an array, or null
         stop = body.get("stop", ["<|eot_id|>"])
         if stop is None:
             stop = []
         elif isinstance(stop, str):
             stop = [stop]
+        if not isinstance(stop, list) or not all(isinstance(s, str) for s in stop):
+            raise BadRequest("'stop' must be a string, an array of strings, or null")
+        try:
+            temperature = float(body.get("temperature", self.args.temperature))
+            max_tokens = int(body.get("max_tokens", -1))
+            seed = body.get("seed")
+            if seed is not None:
+                seed = int(seed)
+        except (TypeError, ValueError) as e:
+            raise BadRequest(f"invalid numeric field: {e}") from None
         return {
             "messages": [
-                {"role": m["role"], "content": m["content"]} for m in body["messages"]
+                {"role": m["role"], "content": m["content"]} for m in messages
             ],
             "stream": bool(body.get("stream", False)),
-            "temperature": float(body.get("temperature", self.args.temperature)),
-            "seed": body.get("seed"),
-            "max_tokens": int(body.get("max_tokens", -1)),
+            "temperature": temperature,
+            "seed": seed,
+            "max_tokens": max_tokens,
             "stop": [s for s in stop if s],
         }
 
@@ -309,33 +355,74 @@ def make_handler(state: ApiState):
             else:
                 self.send_error(404)
 
+        def _send_json(self, status: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_POST(self):
             if self.path != "/v1/chat/completions":
                 self.send_error(404)
                 return
             length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
-            if body.get("stream"):
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Connection", "close")
-                self.end_headers()
+            raw = self.rfile.read(length) or b"{}"
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                self._send_json(
+                    400, {"error": {"message": f"malformed JSON: {e}", "type": "invalid_request_error"}}
+                )
+                return
+            try:
+                # validate BEFORE any SSE headers go out: a 400 must be a
+                # clean HTTP error, not a broken event stream
+                params = state._parse(body)
+            except BadRequest as e:
+                self._send_json(
+                    400, {"error": {"message": str(e), "type": "invalid_request_error"}}
+                )
+                return
+            try:
+                if body.get("stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
 
-                def send_chunk(data: str):
-                    self.wfile.write(f"data: {data}\r\n\r\n".encode())
-                    self.wfile.flush()
+                    def send_chunk(data: str):
+                        self.wfile.write(f"data: {data}\r\n\r\n".encode())
+                        self.wfile.flush()
 
-                state.complete(body, send_chunk)
-                self.close_connection = True
-            else:
-                result = state.complete(body, lambda s: None)
-                payload = json.dumps(result).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                    state.complete(body, send_chunk, params=params)
+                    self.close_connection = True
+                else:
+                    result = state.complete(body, lambda s: None, params=params)
+                    self._send_json(200, result)
+            except BrokenPipeError:
+                pass  # client went away mid-stream
+            except Exception as e:  # engine failure: surface it, keep serving
+                print(f"🛑 request failed: {type(e).__name__}: {e}")
+                if body.get("stream"):
+                    # SSE headers are already out — emit a terminal error
+                    # event so the client sees the failure, not a silent
+                    # truncation
+                    try:
+                        err = json.dumps(
+                            {"error": {"message": str(e), "type": "server_error"}}
+                        )
+                        self.wfile.write(f"data: {err}\r\n\r\ndata: [DONE]\r\n\r\n".encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                else:
+                    self._send_json(
+                        500, {"error": {"message": str(e), "type": "server_error"}}
+                    )
 
     return Handler
 
@@ -345,16 +432,23 @@ def serve(args) -> None:
 
     engine, tokenizer, sampler = make_engine(args)
     state = ApiState(engine, tokenizer, sampler, args)
-    server = HTTPServer(("0.0.0.0", args.port), make_handler(state))
+    # threaded HTTP front (GET /v1/models and queued POSTs stay responsive);
+    # completions themselves serialize on state.lock
+    server = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(state))
+    server.daemon_threads = True
     print(f"Server URL: http://127.0.0.1:{args.port}/v1/")
     server.serve_forever()
 
 
 def main(argv=None) -> None:
     from distributed_llama_tpu.apps.cli import build_parser
-    from distributed_llama_tpu.platform import reassert_jax_platforms
+    from distributed_llama_tpu.platform import (
+        enable_compilation_cache,
+        reassert_jax_platforms,
+    )
 
     reassert_jax_platforms()
+    enable_compilation_cache()
     parser = build_parser()
     parser.add_argument("--port", type=int, default=9990)
     # mode is meaningless here but the shared parser requires it

@@ -6,8 +6,9 @@ The reference has zero tests for its API server (SURVEY.md §4)."""
 import json
 import threading
 import types
+import urllib.error
 import urllib.request
-from http.server import HTTPServer
+from http.server import ThreadingHTTPServer
 
 import jax.numpy as jnp
 import pytest
@@ -49,7 +50,7 @@ def served(tmp_path_factory):
     sampler = Sampler(vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
     args = types.SimpleNamespace(temperature=0.0, topp=0.9, seed=1, chat_template=None)
     state = ApiState(engine, tokenizer, sampler, args)
-    server = HTTPServer(("127.0.0.1", 0), make_handler(state))
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
     port = server.server_address[1]
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
@@ -149,3 +150,101 @@ class TestApi:
         if data["choices"][0]["finish_reason"] == "stop":
             pytest.skip("tiny model emitted EOS on its first greedy token")
         assert data["choices"][0]["finish_reason"] == "length"
+
+
+class TestApiHardening:
+    """Malformed requests get clean 400s and concurrent completions
+    serialize on the engine lock (the reference's single-threaded server
+    crashes its handler on bad JSON, dllama-api.cpp:418-423)."""
+
+    def _post_raw(self, url, data: bytes):
+        req = urllib.request.Request(
+            url + "/v1/chat/completions", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_malformed_json_is_400(self, served):
+        url, _ = served
+        status, body = self._post_raw(url, b"{not json")
+        assert status == 400
+        assert "malformed JSON" in body["error"]["message"]
+
+    def test_missing_messages_is_400(self, served):
+        url, _ = served
+        status, body = self._post_raw(url, json.dumps({"stream": False}).encode())
+        assert status == 400
+        assert "messages" in body["error"]["message"]
+
+    def test_bad_message_shape_is_400(self, served):
+        url, _ = served
+        status, body = self._post_raw(
+            url, json.dumps({"messages": [{"role": "user"}]}).encode()
+        )
+        assert status == 400
+        assert "messages[0]" in body["error"]["message"]
+
+    def test_streaming_bad_request_is_clean_400(self, served):
+        url, _ = served
+        status, body = self._post_raw(
+            url, json.dumps({"stream": True, "messages": []}).encode()
+        )
+        assert status == 400  # a clean HTTP error, not a broken SSE stream
+
+    def test_concurrent_posts_serialize(self, served):
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        results = []
+        errors = []
+
+        def one(i):
+            try:
+                with post(url, {
+                    "messages": [{"role": "user", "content": f"hello {i}"}],
+                    "max_tokens": 4,
+                }) as r:
+                    results.append(json.loads(r.read()))
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        assert len(results) == 3
+        for r in results:
+            assert r["object"] == "chat.completion"
+            assert r["usage"]["completion_tokens"] <= 4
+
+    def test_streaming_engine_failure_sends_error_event(self, served):
+        """An engine failure mid-stream must surface as a terminal SSE error
+        event, not a silently truncated stream."""
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        original = state.engine.prefill
+        state.engine.prefill = lambda toks: (_ for _ in ()).throw(RuntimeError("boom"))
+        try:
+            req = urllib.request.Request(
+                url + "/v1/chat/completions",
+                data=json.dumps({
+                    "stream": True,
+                    "messages": [{"role": "user", "content": "hi"}],
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                raw = r.read().decode()
+        finally:
+            state.engine.prefill = original
+        chunks = [c[len("data: "):] for c in raw.split("\r\n\r\n") if c.startswith("data: ")]
+        assert chunks, raw
+        assert json.loads(chunks[0])["error"]["message"] == "boom"
+        assert chunks[-1] == "[DONE]"
