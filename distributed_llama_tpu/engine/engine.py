@@ -124,15 +124,37 @@ class InferenceEngine:
             self._forward = functools.partial(self._forward_single, self.cfg)
         self.pos = 0
         self.stats: list[TokenStats] = []
-        self._transfer_ms: float | None = None  # measured lazily under TP
+        self._transfer_ms: float | None = None  # measured lazily under TP/SP
+        self._transfer_measured_at = 0  # token count at the last measurement
+        self._pipeline_depth = 0  # >0 while a speculative chunk is in flight
+
+    # decoded tokens between transfer re-measurements: the estimate follows
+    # actual interconnect load over a session for the cost of one tiny
+    # probe dispatch every ~512 tokens, instead of staying a
+    # construction-time constant
+    TRANSFER_REFRESH_TOKENS = 512
 
     def _transfer_ms_per_token(self) -> float:
-        """Per-dispatch collective cost: 0 on a single chip; under TP measured
-        once on the real mesh (see module docstring)."""
+        """Per-dispatch collective cost: 0 on a single chip; under TP/SP
+        measured on the real mesh and re-measured periodically in situ.
+
+        Refreshes happen only at QUIESCENT points (no dispatch in flight):
+        inside the pipelined chunk loop a probe would queue behind the
+        in-flight chunk and time its compute, poisoning the very split it
+        feeds. The prefill/forward/decode_chunk paths all reach here right
+        after their own fetch drained the stream, so every API request and
+        every stepwise loop refreshes on cadence; generate_chunks reuses
+        the last measurement."""
         if self._tp_engine is None:
             return 0.0
-        if self._transfer_ms is None:
+        n = sum(s.n_tokens for s in self.stats)
+        due = (
+            self._transfer_ms is None
+            or n - self._transfer_measured_at >= self.TRANSFER_REFRESH_TOKENS
+        )
+        if due and (self._pipeline_depth == 0 or self._transfer_ms is None):
             self._transfer_ms = self._tp_engine.measure_transfer_ms()
+            self._transfer_measured_at = n
         return self._transfer_ms
 
     def _last_dispatches(self) -> int:
@@ -167,6 +189,9 @@ class InferenceEngine:
     def reset(self) -> None:
         self.pos = 0
         self.stats.clear()
+        # keep the last transfer measurement (still valid) but restart the
+        # refresh cadence with the cleared token count
+        self._transfer_measured_at = 0
 
     def rollback(self, pos: int) -> None:
         """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots
@@ -357,6 +382,21 @@ class InferenceEngine:
         k = min(chunk, self.cfg.seq_len - self.pos)
         pending, key = self._dispatch_chunk(int(first_token), k, temperature, topp, key)
         pending_n = k
+        # a speculative chunk is in flight for the rest of the loop: the
+        # transfer estimate must not re-measure here (see
+        # _transfer_ms_per_token); the generator's finally covers early
+        # consumer exits (EOS/stop breaks close the generator)
+        self._pipeline_depth += 1
+        try:
+            yield from self._generate_chunks_pipelined(
+                pending, pending_n, stop, chunk, temperature, topp, key
+            )
+        finally:
+            self._pipeline_depth -= 1
+
+    def _generate_chunks_pipelined(
+        self, pending, pending_n, stop, chunk, temperature, topp, key
+    ):
         while True:
             # the timed window covers dispatch+fetch only — consumer time
             # between yields must not be attributed to the engine's stats

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llama_tpu.ops import kv_cache as kvc
+from distributed_llama_tpu.parallel.tensor_parallel import TransferProbeMixin
 
 
 def _chunk_attention(
@@ -169,7 +170,7 @@ def sp_decode_attention(
 # ---------------------------------------------------------------------------
 
 
-class SequenceParallelForward:
+class SequenceParallelForward(TransferProbeMixin):
     """Sequence/context parallelism as an engine backend: the KV cache is
     sharded along the SEQUENCE axis over an ``sp`` mesh (device i owns slots
     [i*S/n, (i+1)*S/n)), weights are replicated, prefill runs
@@ -411,14 +412,13 @@ class SequenceParallelForward:
         self._decode_cache[key_] = jitted
         return jitted
 
-    def measure_transfer_ms(self, n_tokens: int = 32) -> float:
-        """Per-token collective cost of the sp decode: per layer one pmax +
-        two psums of the online-softmax partials (see sp_decode_attention),
-        plus the two tp all-reduces when a 2-D mesh is in use, timed
-        back-to-back on the real mesh (upper bound; same methodology as
-        TensorParallelForward.measure_transfer_ms)."""
-        import time as _time
-
+    def transfer_probe(self, n_tokens: int = 32):
+        """(jitted_fn, example_args) replaying the sp decode's collective
+        sequence: per layer one pmax + two psums of the online-softmax
+        partials (see sp_sharded_attention), plus the two tp all-reduces
+        when a 2-D mesh is in use. Exposed so tests can compile it and
+        assert the collectives survive XLA DCE (the keep-alive arithmetic
+        is what the timing validity rests on)."""
         cfg = self.cfg
         H, hd = cfg.n_heads, cfg.head_size
         K = cfg.n_kv_heads // self.tp  # local KV heads under the 2-D mesh
@@ -453,18 +453,10 @@ class SequenceParallelForward:
             fn, mesh=self.mesh, in_specs=(P(), P(), P()), out_specs=(P(), P(), P()),
             check_vma=False,
         )
-        jitted = jax.jit(mapped)
         m = jnp.ones((1, K, M), jnp.float32)
         o = jnp.ones((1, K, M, hd), jnp.float32)
         z = jnp.ones((1, cfg.dim), jnp.float32)
-        out = jitted(m, o, z)
-        jax.block_until_ready(out)
-        import numpy as np
-
-        t0 = _time.perf_counter()
-        np.asarray(jitted(m, o, z)[0])
-        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
-        return elapsed_ms / n_tokens
+        return jax.jit(mapped), (m, o, z)
 
 
 def _sp_logits(cfg, tp_axis, params, x):

@@ -1,0 +1,149 @@
+"""True expert parallelism (prototype): experts partitioned over an ``ep``
+mesh axis with ``lax.all_to_all`` token routing.
+
+The production MoE path TP-slices experts exactly like the reference (every
+shard holds a 1/tp hidden-slice of ALL experts,
+reference: src/transformer.cpp:335-353) — that is the right layout when
+E is small and tokens are few (decode). TRUE expert parallelism is the
+named extension beyond the reference (SURVEY.md §2 parallelism table):
+device d owns E/ep WHOLE experts, and tokens travel to their experts:
+
+1. tokens are sharded over ``ep`` ([Tl, D] per device); the (replicated)
+   router picks top-k experts per local token,
+2. each (token, choice) pair is scattered into a per-destination-device
+   send buffer at a collision-free slot (slot = t*k + j, capacity Tl*k —
+   the prototype never drops tokens),
+3. one ``lax.all_to_all`` moves the buffers: device d receives every
+   token routed to ITS experts,
+4. d runs its local expert bank on the received rows (masked one-hot
+   mixing over its E/ep experts),
+5. a second ``all_to_all`` returns the outputs to the tokens' home
+   devices, which combine them with the renormalized router weights.
+
+This is the classic dispatch/compute/combine MoE exchange (two all-to-alls
+riding ICI) — the communication pattern the reference's TCP star cannot
+express at all. Prototype status: capacity is Tl*k with unique slots
+(collision-free but sparse — a production version would sort-compact the
+buckets), and the expert compute is the stacked-bf16 bank path. Validated
+against the dense MoE path on the virtual CPU mesh
+(tests/test_expert_parallel.py), which also micro-benchmarks it against
+TP-sliced experts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.models.config import LlamaConfig
+
+
+def ep_moe_ffn_local(
+    cfg: LlamaConfig,
+    ep: int,
+    axis_name: str,
+    xn_local: jax.Array,  # [Tl, D] this device's token slice (normed)
+    router: jax.Array,  # [D, E] replicated
+    gate_l: jax.Array,  # [El, D, H] this device's expert slice
+    up_l: jax.Array,  # [El, D, H]
+    down_l: jax.Array,  # [El, H, D]
+) -> jax.Array:
+    """shard_map body: expert-parallel MoE FFN for one layer. Returns the
+    local [Tl, D] output slice (f32)."""
+    from distributed_llama_tpu.models.llama import _activation
+    from distributed_llama_tpu.models.moe import router_probs
+
+    Tl, D = xn_local.shape
+    E = cfg.n_experts
+    El = E // ep
+    k = cfg.n_active_experts
+    C = Tl * k  # per-destination capacity: one unique slot per (token, choice)
+
+    probs = router_probs(cfg, xn_local, router)  # [Tl, E]
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # [Tl, k]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+
+    dest = top_idx // El  # owning device of each choice [Tl, k]
+    local_eid = top_idx % El  # expert id within the owner's bank
+    t_ids = jnp.broadcast_to(jnp.arange(Tl)[:, None], (Tl, k))
+    slot = t_ids * k + jnp.broadcast_to(jnp.arange(k)[None, :], (Tl, k))  # unique
+
+    # dispatch buffers: send[d, c] = the token row bound for device d's slot c
+    send_x = jnp.zeros((ep, C, D), xn_local.dtype).at[dest, slot].set(
+        xn_local[t_ids]
+    )
+    send_eid = jnp.full((ep, C), -1, jnp.int32).at[dest, slot].set(local_eid)
+
+    # all_to_all #1: recv[s, c] = what device s sent me (tokens for MY experts)
+    recv_x = jax.lax.all_to_all(send_x, axis_name, 0, 0)
+    recv_eid = jax.lax.all_to_all(send_eid, axis_name, 0, 0)
+
+    # local expert compute: masked one-hot mixing over this device's bank
+    flat = recv_x.reshape(ep * C, D)
+    eid = recv_eid.reshape(ep * C)
+    xc = flat.astype(gate_l.dtype)
+    g = jnp.einsum("td,edh->teh", xc, gate_l, preferred_element_type=jnp.float32)
+    u = jnp.einsum("td,edh->teh", xc, up_l, preferred_element_type=jnp.float32)
+    h = _activation(g, cfg.hidden_act) * u  # [ep*C, El, H]
+    d_out = jnp.einsum(
+        "teh,ehd->ted", h.astype(down_l.dtype), down_l,
+        preferred_element_type=jnp.float32,
+    )  # [ep*C, El, D]
+    onehot = jax.nn.one_hot(eid, El, dtype=jnp.float32)  # -1 rows -> all-zero
+    out_flat = jnp.einsum("te,ted->td", onehot, d_out)  # [ep*C, D]
+
+    # all_to_all #2: outputs return to their home devices in slot order
+    back = jax.lax.all_to_all(out_flat.reshape(ep, C, D), axis_name, 0, 0)
+
+    # combine: out[t] = sum_j w[t, j] * back[dest[t, j], slot[t, j]]
+    gathered = back[dest, slot]  # [Tl, k, D]
+    return jnp.einsum("tk,tkd->td", top_vals, gathered)
+
+
+class ExpertParallelMoE:
+    """A single expert-parallel MoE FFN layer over a 1-D ``ep`` mesh.
+
+    Holds the jitted shard_map'd exchange; expert banks shard over the
+    expert axis (device d owns whole experts [d*E/ep, (d+1)*E/ep)), tokens
+    shard over the same axis. The benchmark comparison point is the
+    TP-sliced layout (models/moe.moe_ffn under a tp axis)."""
+
+    def __init__(self, cfg: LlamaConfig, ep: int, devices=None):
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from distributed_llama_tpu.parallel.tensor_parallel import shard_map
+
+        if cfg.n_experts % ep:
+            raise ValueError(f"ep={ep} must divide n_experts={cfg.n_experts}")
+        if devices is None:
+            devices = jax.devices()[:ep]
+        self.cfg = cfg
+        self.ep = ep
+        self.mesh = Mesh(
+            mesh_utils.create_device_mesh((ep,), devices=devices), ("ep",)
+        )
+        fn = functools.partial(ep_moe_ffn_local, cfg, ep, "ep")
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(
+                P("ep", None),  # tokens
+                P(),  # router replicated
+                P("ep", None, None),  # gate bank
+                P("ep", None, None),  # up bank
+                P("ep", None, None),  # down bank
+            ),
+            out_specs=P("ep", None),
+            check_vma=False,
+        )
+        self._jitted = jax.jit(mapped)
+
+    def __call__(self, xn, router, gate, up, down):
+        """xn: [T, D] (T divisible by ep); banks: [E, D, H] / [E, H, D].
+        Returns [T, D] f32."""
+        if xn.shape[0] % self.ep:
+            raise ValueError(f"T={xn.shape[0]} must be divisible by ep={self.ep}")
+        return self._jitted(xn, router, gate, up, down)

@@ -86,19 +86,36 @@ def make_interop_tokenizer(vocab_size: int) -> Tokenizer:
     )
 
 
-@pytest.fixture(scope="module")
-def interop_files(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("interop")
-    spec = tiny_spec(
-        dim=DIM,
-        hidden_dim=HIDDEN,
-        n_layers=2,
-        n_heads=4,
-        n_kv_heads=4,
-        vocab_size=VOCAB,
-        seq_len=32,
-        weights_float_type=FloatType.Q40,
+def _arch_spec(arch: str):
+    """Tiny interop spec per architecture family. MoE archs leave rope
+    UNKNOWN so both engines resolve it the same way (falcon/neox for
+    GROK1/MIXTRAL, reference: src/transformer.cpp:88-96 = our
+    ModelSpec.resolved_rope_type); Grok uses GELU — its MoE task chain
+    dispatches the activation correctly (src/grok1-tasks.cpp:154-157),
+    unlike the reference's dense-FFN hiddenDim==GELU bug."""
+    from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct
+
+    common = dict(
+        dim=DIM, hidden_dim=HIDDEN, n_layers=2, n_heads=4, n_kv_heads=4,
+        vocab_size=VOCAB, seq_len=32, weights_float_type=FloatType.Q40,
     )
+    if arch == "llama":
+        return tiny_spec(**common)
+    if arch == "mixtral":
+        return tiny_spec(
+            arch_type=ArchType.MIXTRAL, n_experts=4, n_active_experts=2,
+            **common,
+        )
+    return tiny_spec(
+        arch_type=ArchType.GROK1, n_experts=4, n_active_experts=2,
+        hidden_act=HiddenAct.GELU, **common,
+    )
+
+
+@pytest.fixture(scope="module", params=["llama", "mixtral", "grok1"])
+def interop_files(request, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp(f"interop-{request.param}")
+    spec = _arch_spec(request.param)
     tensors = random_tensors(spec, seed=3)
     model_path = str(tmp / "interop.m")
     tok_path = str(tmp / "interop.t")
@@ -154,7 +171,9 @@ def our_generate(model, tok: Tokenizer, prompt: str, steps: int) -> str:
     from distributed_llama_tpu.engine import InferenceEngine
 
     engine = InferenceEngine(model, dtype=jnp.float32)
-    prompt_tokens = tok.encode(prompt, add_bos=True)
+    # the reference skips BOS for Grok-1 (dllama.cpp:25-26), as does our CLI
+    add_bos = engine.cfg.arch.name != "GROK1"
+    prompt_tokens = tok.encode(prompt, add_bos=add_bos)
     token = prompt_tokens[0]
     pieces = []
     pos = 0

@@ -156,3 +156,65 @@ class TestTransferMeasurement:
             if len(got) == 6:
                 break
         assert got == want
+
+
+class TestTransferProbeDce:
+    """The transfer probes' collectives must survive XLA DCE: the I/T split
+    is only a measurement if the compiled program actually runs them
+    (guards the keep-alive arithmetic against compiler-version drift)."""
+
+    def test_tp_probe_keeps_collectives(self):
+        from distributed_llama_tpu.models.config import config_from_spec
+        from distributed_llama_tpu.parallel.tensor_parallel import (
+            TensorParallelForward,
+        )
+
+        cfg = config_from_spec(tiny_spec(
+            dim=64, n_heads=4, n_kv_heads=4, hidden_dim=128,
+            vocab_size=64, seq_len=16, n_layers=2,
+        ))
+        fwd = TensorParallelForward(cfg, 2, layered=True)
+        jitted, args = fwd.transfer_probe(n_tokens=4)
+        hlo = jitted.lower(*args).compile().as_text()
+        # 2 psums per layer (wo + down); shard_vocab adds an all-gather
+        assert "all-reduce" in hlo
+        if fwd.shard_vocab:
+            assert "all-gather" in hlo
+
+    def test_sp_probe_keeps_collectives(self):
+        from distributed_llama_tpu.models.config import config_from_spec
+        from distributed_llama_tpu.parallel.context_parallel import (
+            SequenceParallelForward,
+        )
+        from tests.model_utils import tiny_spec
+
+        cfg = config_from_spec(tiny_spec(
+            dim=64, n_heads=4, n_kv_heads=4, hidden_dim=128,
+            vocab_size=64, seq_len=16, n_layers=2,
+        ))
+        fwd = SequenceParallelForward(cfg, 2, tp=2)
+        jitted, args = fwd.transfer_probe(n_tokens=4)
+        hlo = jitted.lower(*args).compile().as_text()
+        # pmax + psums over sp, plus the tp wo/down all-reduces
+        assert hlo.count("all-reduce") >= 1
+
+    def test_engine_refreshes_transfer_estimate(self, tmp_path):
+        """The in-situ contract: after TRANSFER_REFRESH_TOKENS decoded
+        tokens, the next stats entry re-measures instead of reusing the
+        construction-time constant."""
+        from distributed_llama_tpu.engine import InferenceEngine
+        from tests.model_utils import random_tensors, tiny_spec, write_model_file
+
+        spec = tiny_spec(dim=64, n_heads=4, n_kv_heads=4, hidden_dim=128,
+                         vocab_size=64, seq_len=64)
+        path = str(tmp_path / "refresh.m")
+        write_model_file(path, spec, random_tensors(spec, seed=1))
+        e = InferenceEngine(path, dtype=jnp.float32, tp=2)
+        e.TRANSFER_REFRESH_TOKENS = 4
+        calls = []
+        orig = e._tp_engine.measure_transfer_ms
+        e._tp_engine.measure_transfer_ms = lambda *a, **k: calls.append(1) or orig()
+        e.prefill([1, 2, 3])
+        for _ in range(3):
+            e.generate_on_device(5, 4, temperature=0.0)
+        assert len(calls) >= 3  # re-measured as the token count crossed 4, 8, ...
