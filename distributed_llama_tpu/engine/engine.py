@@ -147,12 +147,16 @@ class InferenceEngine:
         the last measurement."""
         if self._tp_engine is None:
             return 0.0
+        if self._pipeline_depth > 0:
+            # never measure mid-flight (even the FIRST time — a caller whose
+            # first op is generate_chunks would otherwise cache a poisoned
+            # estimate); report 0 until a quiescent call measures
+            return self._transfer_ms or 0.0
         n = sum(s.n_tokens for s in self.stats)
-        due = (
+        if (
             self._transfer_ms is None
             or n - self._transfer_measured_at >= self.TRANSFER_REFRESH_TOKENS
-        )
-        if due and (self._pipeline_depth == 0 or self._transfer_ms is None):
+        ):
             self._transfer_ms = self._tp_engine.measure_transfer_ms()
             self._transfer_measured_at = n
         return self._transfer_ms

@@ -299,9 +299,14 @@ def _make_q40_kernel(compute_dtype):
         hi = (qs >> 4).astype(compute_dtype)
         bn2, bd = qs.shape
         # lo/hi rows are CONSECUTIVE logical rows: each scale row broadcasts
-        # over its 32-row block
-        wlo = (lo.reshape(-1, QK, bd) * slo_ref[:].astype(compute_dtype)[:, None, :]).reshape(bn2, bd)
-        whi = (hi.reshape(-1, QK, bd) * shi_ref[:].astype(compute_dtype)[:, None, :]).reshape(bn2, bd)
+        # over its 32-row block. jnp.repeat expands the SMALL scales tile to
+        # [bn2, bd] and multiplies in 2-D — reshaping the big nibble tile to
+        # [blocks, 32, bd] and back instead costs Mosaic relayouts on the
+        # large array (measured 61 -> 68 tok/s end-to-end on a 7B decode).
+        # NOT pltpu.repeat: that tiles whole copies (s[r % nb], not the
+        # needed s[r // 32]) — numerically wrong here.
+        wlo = lo * jnp.repeat(slo_ref[:].astype(compute_dtype), QK, axis=0)
+        whi = hi * jnp.repeat(shi_ref[:].astype(compute_dtype), QK, axis=0)
         acc_ref[:] += jnp.dot(xlo_ref[:], wlo, preferred_element_type=jnp.float32)
         acc_ref[:] += jnp.dot(xhi_ref[:], whi, preferred_element_type=jnp.float32)
 
