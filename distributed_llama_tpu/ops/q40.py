@@ -332,13 +332,7 @@ def q40_matmul(
     np_, dp = qm.n_padded, qm.d_padded
     T = x.shape[0]
     _validate_env_tiles()
-    # VMEM budget (measured on v5e, 16MB scoped limit): the dominant tiles
-    # are the int32 + 2x bf16 dequant forms (~8 B per packed element) plus
-    # the [T, bd] f32 accumulator; shrink the output tile as T grows
-    if T > 8:
-        block_d = min(block_d, 512)
-    if T > 256:
-        block_d = min(block_d, 256)
+    block_d = _shrink_block_d(T, block_d)
     # tiles must divide the (padded) dims; block_n granule 512: the x window
     # (T, bn/2) needs bn/2 % 128 == 0 and the scales tile (bn/64, bd) needs
     # bn/64 % 8 == 0 (mosaic sublane/lane tiling rules) — smaller matrices
@@ -397,6 +391,32 @@ def q40_matmul(
     )
     out = out - 8.0 * corr
     return out[:, :d] if dp != d else out
+
+
+def _shrink_block_d(T: int, block_d: int) -> int:
+    """Batch-size-dependent output-tile cap, tuned on the real v5e by
+    measuring the FULL 7B prefill program per config (round 5; per-kernel
+    microbenchmarks are unusable behind the tunnel — the ~100 ms round trip
+    jitter swamps sub-ms kernels):
+
+      T=16:  bd512 15.9 ms | bd2048 21.2      -> keep 512
+      T=32:  bd512 17.4 | bd1024 14.7 | bd2048 16.1 -> 1024
+      T=64:  bd512 24.0 | bd1024 16.8 | bd2048 14.8 -> full (38% faster
+             than the round-4 decode-tuned 512 cap)
+      T=128: bd512 21.3 | bd2048 17.5           -> full
+      T=256: bd256 34.2 | bd2048 30.5           -> full
+      T=512: bd2048 fails to compile (VMEM), bd1024 75.8 | bd256 84.8 -> 1024
+
+    DLT_NO_SHRINK=1 disables the cap (tile-tuning experiments only)."""
+    if _os.environ.get("DLT_NO_SHRINK"):
+        return block_d
+    if T <= 8:
+        return block_d  # decode regime: 2048 profiled ~4% over 1024 (round 3)
+    if T <= 16:
+        return min(block_d, 512)
+    if T <= 32 or T > 256:
+        return min(block_d, 1024)
+    return block_d
 
 
 def _largest_divisor_tile(dim: int, target: int, granule: int) -> int | None:
