@@ -155,15 +155,37 @@ def generate(args, benchmark: bool) -> None:
         raise SystemExit("Expected at least 1 prompt token")
 
     total_start = time.perf_counter()
-    logits = engine.prefill(prompt_tokens)
-    if benchmark:
-        stats = engine.stats[-1]
-        _print(f"🔷 P {stats.generation_ms:5.0f} ms ({n_prompt} prompt tokens) ")
+    if args.decode == "device":
+        # prefill→decode fusion: the first token is sampled on device and the
+        # first decode chunk is dispatched before anything is fetched — one
+        # tunnel round trip per request instead of two (engine.prefill_device)
+        first_dev, key = engine.prefill_device(
+            prompt_tokens, args.temperature, args.topp, seed=sampler.seed
+        )
+        logits = None
+    else:
+        logits = engine.prefill(prompt_tokens)
+    # fused path: the prefill stats entry only gains its device-compute
+    # drain time when the first token is fetched (engine._fetch_fused_first),
+    # so the P line is deferred until then — printing it here would report
+    # async dispatch overhead, not prefill latency
+    p_entry = engine.stats[-1] if benchmark else None
+    p_printed = False
+    if benchmark and args.decode != "device":
+        _print(f"🔷 P {p_entry.generation_ms:5.0f} ms ({n_prompt} prompt tokens) ")
+        p_printed = True
     _print(tokenizer.decode(prompt_tokens))
     if benchmark:
         _print("\n")
 
+    def print_p_line() -> None:
+        nonlocal p_printed
+        if benchmark and not p_printed:
+            _print(f"🔷 P {p_entry.generation_ms:5.0f} ms ({n_prompt} prompt tokens)\n")
+            p_printed = True
+
     def emit(prev: int, tok: int) -> None:
+        print_p_line()
         stats = engine.stats[-1]
         if benchmark:
             _print(
@@ -178,28 +200,30 @@ def generate(args, benchmark: bool) -> None:
 
     token = prompt_tokens[-1]
     generated = 0
-    # first generated token always samples on host from the prefill logits
-    next_token = sampler.sample(logits)
-    if next_token != tokenizer.bos_id:  # BOS delimits sequences (dllama.cpp:68-71)
-        emit(token, next_token)
-        generated += 1
-        token = next_token
-        if args.decode == "device":
+    if args.decode == "device":
 
-            def on_token(prev: int, t: int) -> bool:
-                nonlocal generated, token
-                if t == tokenizer.bos_id:
-                    return False  # BOS delimits sequences (dllama.cpp:68-71)
-                emit(prev, t)
-                generated += 1
-                token = t
-                return True
+        def on_token(prev: int, t: int) -> bool:
+            nonlocal generated, token
+            if t == tokenizer.bos_id:
+                return False  # BOS delimits sequences (dllama.cpp:68-71)
+            emit(prev, t)
+            generated += 1
+            token = t
+            return True
 
-            engine.stream_decode(
-                token, on_token, args.temperature, args.topp,
-                seed=sampler.seed, chunk=args.decode_chunk, limit=args.steps,
-            )
-        else:
+        engine.stream_decode(
+            first_dev, on_token, args.temperature, args.topp,
+            seed=sampler.seed, chunk=args.decode_chunk, limit=args.steps,
+            key=key, first_prev=prompt_tokens[-1],
+        )
+        print_p_line()  # zero-token streams (immediate BOS) still report P
+    else:
+        # first generated token samples on host from the prefill logits
+        next_token = sampler.sample(logits)
+        if next_token != tokenizer.bos_id:  # BOS delimits sequences (dllama.cpp:68-71)
+            emit(token, next_token)
+            generated += 1
+            token = next_token
             while engine.pos < args.steps:
                 logits = engine.decode_step(token)
                 next_token = sampler.sample(logits)
@@ -245,7 +269,16 @@ def chat(args) -> None:
 
         budget = seq_len - engine.pos
         tokens = tokens[:budget]
-        logits = engine.prefill(tokens)
+        turn_seed = sampler.seed + engine.pos  # vary the stream per turn
+        if args.decode == "device":
+            # prefill→decode fusion (see generate): first token sampled on
+            # device, no host round trip between prompt and reply
+            first_dev, key = engine.prefill_device(
+                tokens, args.temperature, args.topp, seed=turn_seed
+            )
+            logits = None
+        else:
+            logits = engine.prefill(tokens)
         _print("\n🤖 Assistant\n")
 
         detector = EosDetector(
@@ -262,26 +295,25 @@ def chat(args) -> None:
                 detector.clear()
             return res
 
-        prev = tokens[-1]
-        token = sampler.sample(logits)
-        res = feed(prev, token)
-        if res != EosDetectorResult.EOS and engine.pos < seq_len:
-            if args.decode == "device":
+        if args.decode == "device":
+            res = EosDetectorResult.NOT_EOS
 
-                def on_token(prev: int, t: int) -> bool:
-                    nonlocal res, token
-                    res = feed(prev, t)
-                    token = t
-                    return res != EosDetectorResult.EOS
+            def on_token(prev: int, t: int) -> bool:
+                nonlocal res, token
+                res = feed(prev, t)
+                token = t
+                return res != EosDetectorResult.EOS
 
-                # vary the stream per turn: the same base seed would replay
-                # the same draw sequence every reply
-                engine.stream_decode(
-                    token, on_token, args.temperature, args.topp,
-                    seed=sampler.seed + engine.pos, chunk=args.decode_chunk,
-                    limit=seq_len,
-                )
-            else:
+            engine.stream_decode(
+                first_dev, on_token, args.temperature, args.topp,
+                seed=turn_seed, chunk=args.decode_chunk,
+                limit=seq_len, key=key, first_prev=tokens[-1],
+            )
+        else:
+            prev = tokens[-1]
+            token = sampler.sample(logits)
+            res = feed(prev, token)
+            if res != EosDetectorResult.EOS and engine.pos < seq_len:
                 while engine.pos < seq_len:
                     logits = engine.decode_step(token)
                     prev = token

@@ -13,12 +13,22 @@ exact collective sequence on the real mesh
 (TensorParallelForward.measure_transfer_ms) and subtracted from the step
 time — the collectives are fused inside one XLA program, so they cannot be
 timed in situ the way the reference times its TASK_TYPE_TRANSFER tasks.
+
+Concurrency: one engine owns the weights and the compiled programs; the
+mutable decode state (KV cache, position, stats) lives in
+:class:`EngineStream`. ``engine.new_stream()`` adds an independent stream
+sharing the same weights — the API server interleaves several completions
+this way (the reference is architecturally single-stream: one socket accept
+drives one inference at a time, dllama-api.cpp:418-423). The engine itself
+delegates the classic single-stream surface to a default stream, so CLI and
+tests are unchanged.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 import time
 
 import jax
@@ -52,11 +62,447 @@ class TokenStats:
     n_tokens: int = 1
 
 
+class EngineStream:
+    """One independent generation stream: its own KV cache, position and
+    stats, sharing the owning engine's weights and compiled programs.
+
+    All per-request state lives here so several streams can decode
+    concurrently on one engine (each dispatch is whole-program and
+    asynchronous; interleaved dispatches from different streams simply queue
+    on the device stream in order)."""
+
+    def __init__(self, engine: "InferenceEngine", cache):
+        self.engine = engine
+        self.cache = cache
+        self.pos = 0
+        self.stats: list[TokenStats] = []
+        # the prefill_device stats entry awaiting its compute-drain time
+        # (added when generate_chunks fetches the fused first token)
+        self._pending_prefill_entry: TokenStats | None = None
+        engine._streams.append(self)
+
+    @property
+    def cfg(self) -> LlamaConfig:
+        return self.engine.cfg
+
+    # ------------------------------------------------------------------
+    # Generation API
+    # ------------------------------------------------------------------
+
+    def reset(self) -> None:
+        self.pos = 0
+        self.stats.clear()
+        # keep the engine's last transfer measurement (still valid) but
+        # restart the refresh cadence with the cleared token count
+        self.engine._transfer_measured_at = 0
+
+    def rollback(self, pos: int) -> None:
+        """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots
+        beyond ``pos`` are stale but unreachable: attention masks s <= pos and
+        every slot is overwritten before the position pointer crosses it."""
+        if not 0 <= pos <= self.pos:
+            raise ValueError(f"cannot rollback to {pos} from {self.pos}")
+        self.pos = pos
+
+    def _forward_device(self, tokens: np.ndarray):
+        """Dispatch one forward; returns DEVICE logits [T_padded, vocab].
+        Advances pos and records stats (the timing covers dispatch only —
+        callers append their fetch to the same stats entry implicitly by
+        measuring around their np.asarray)."""
+        engine = self.engine
+        n = tokens.shape[0]
+        if n == 0:
+            raise ValueError("empty token batch: at least one token required")
+        if self.pos + n > engine.cfg.seq_len:
+            raise ValueError(f"context overflow: pos {self.pos} + {n} > {engine.cfg.seq_len}")
+        if n == 1 or (
+            # backends that chunk mid-context prompts themselves (sp) pad to
+            # their own fixed chunk width — engine bucket-padding on top
+            # would only inflate the dispatch count
+            self.pos > 0
+            and getattr(engine._tp_engine, "prefers_exact_mid_prefill", False)
+        ):
+            padded = tokens
+        else:
+            bucket = _prefill_bucket(n)
+            if self.pos + bucket > engine.cfg.seq_len:
+                bucket = n  # exact-length compile near the context limit
+            padded = np.zeros(bucket, dtype=np.int32)
+            padded[:n] = tokens
+        logits, self.cache = engine._forward(
+            engine.params, jnp.asarray(padded), self.cache, jnp.int32(self.pos)
+        )
+        self.pos += n
+        return logits
+
+    def forward(self, tokens: list[int] | np.ndarray) -> np.ndarray:
+        """Run tokens at the current position; returns f32 logits [T, vocab]
+        (padded positions stripped). Advances pos by len(tokens)."""
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = tokens.shape[0]
+        start = time.perf_counter()
+        logits = np.asarray(self._forward_device(tokens)[:n])
+        elapsed = (time.perf_counter() - start) * 1000.0
+        self.stats.append(
+            self.engine._split_stats(
+                elapsed, n_tokens=n, n_dispatches=self.engine._last_dispatches()
+            )
+        )
+        return logits
+
+    def prefill(self, tokens: list[int]) -> np.ndarray:
+        """Process a prompt in one batched step; returns last-token logits.
+
+        Only the LAST position's logits row cross the host boundary: a
+        64-token prefill of a 32k-vocab model would otherwise ship 8 MB of
+        f32 logits per prompt (measured ~2 s through a remote PJRT tunnel
+        vs ~tens of ms for the row)."""
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = tokens.shape[0]
+        start = time.perf_counter()
+        logits = np.asarray(self._forward_device(tokens)[n - 1])
+        elapsed = (time.perf_counter() - start) * 1000.0
+        self.stats.append(
+            self.engine._split_stats(
+                elapsed, n_tokens=n, n_dispatches=self.engine._last_dispatches()
+            )
+        )
+        return logits
+
+    def prefill_device(self, tokens: list[int], temperature, topp, seed: int):
+        """Prefill + sample the first generated token ON DEVICE; returns the
+        sampled token as a device scalar (NOT fetched) plus the PRNG key the
+        decode stream continues from.
+
+        This removes the prompt→first-token host round trip entirely: the
+        returned scalar feeds :meth:`generate_chunks` without ever visiting
+        the host, so time-to-first-token is one device prefill + one chunk
+        instead of two tunnel round trips (measured ~96 ms each behind a
+        remote PJRT tunnel, docs/PERF.md).
+
+        The stats entry recorded here covers the ASYNC dispatch only; the
+        prefill's device compute drains at the first-token fetch inside
+        ``generate_chunks(emit_first=True)``, which adds that drain time back
+        onto this entry (``_pending_prefill_entry``) so the P line still
+        reports true prefill latency."""
+        engine = self.engine
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = tokens.shape[0]
+        start = time.perf_counter()
+        # the dispatches below are never fetched here: mark the engine
+        # non-quiescent so the transfer probe does not queue behind them and
+        # time their compute (see _transfer_ms_per_token)
+        with engine._depth_lock:
+            engine._pipeline_depth += 1
+        try:
+            logits = self._forward_device(tokens)
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            token = engine._sample_row(
+                logits, jnp.int32(n - 1), sub, jnp.float32(temperature), jnp.float32(topp)
+            )
+            elapsed = (time.perf_counter() - start) * 1000.0
+            entry = engine._split_stats(
+                elapsed, n_tokens=n, n_dispatches=engine._last_dispatches()
+            )
+            self.stats.append(entry)
+            self._pending_prefill_entry = entry
+        finally:
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+        return token, key
+
+    def decode_step(self, token: int) -> np.ndarray:
+        """One autoregressive step; returns f32 logits [vocab]."""
+        return self.forward([token])[0]
+
+    def generate_on_device(
+        self,
+        first_token: int,
+        n_steps: int,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Generate n_steps tokens in ONE device program (no per-token host
+        round trip). Returns int32 [n_steps]. Under TP the loop is
+        shard_map'd over the mesh with collectives riding every step."""
+        engine = self.engine
+        if self.pos + n_steps > engine.cfg.seq_len:
+            raise ValueError(f"context overflow: pos {self.pos} + {n_steps}")
+        from distributed_llama_tpu.models import sampling
+
+        start = time.perf_counter()
+        if engine._tp_engine is not None:
+            tokens, self.cache = engine._tp_engine.decode_loop(
+                engine.params,
+                jnp.int32(first_token),
+                self.cache,
+                jnp.int32(self.pos),
+                n_steps,
+                float(temperature),
+                float(topp),
+                jax.random.PRNGKey(seed),
+            )
+        else:
+            tokens, self.cache = sampling.decode_loop(
+                engine.cfg,
+                engine.params,
+                jnp.int32(first_token),
+                self.cache,
+                jnp.int32(self.pos),
+                n_steps,
+                float(temperature),
+                float(topp),
+                jax.random.PRNGKey(seed),
+            )
+        tokens = np.asarray(tokens)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        self.stats.extend([engine._split_stats(elapsed_ms / n_steps)] * n_steps)
+        self.pos += n_steps
+        return tokens
+
+    def _dispatch_chunk(self, first_token, n_steps: int, temperature, topp, key):
+        """Dispatch one decode chunk WITHOUT fetching: returns the device
+        token array and the advanced key. ``first_token`` may be a host int
+        or a device scalar (the previous chunk's last token — the pipelined
+        path never waits on it). Advances pos by n_steps."""
+        from distributed_llama_tpu.models import sampling
+
+        engine = self.engine
+        if engine._tp_engine is not None:
+            tokens, self.cache, key = engine._tp_engine.decode_chunk(
+                engine.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
+                n_steps, temperature, topp, key,
+            )
+        else:
+            tokens, self.cache, key = sampling.decode_chunk(
+                engine.cfg, engine.params, jnp.int32(first_token), self.cache,
+                jnp.int32(self.pos), n_steps, jnp.float32(temperature),
+                jnp.float32(topp), key,
+            )
+        self.pos += n_steps
+        return tokens, key
+
+    def decode_chunk(self, first_token: int, n_steps: int, temperature, topp, key):
+        """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
+        temperature/topp (no recompile when a request changes them). Returns
+        (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
+        start = time.perf_counter()
+        tokens, key = self._dispatch_chunk(first_token, n_steps, temperature, topp, key)
+        tokens = np.asarray(tokens)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        self.stats.extend([self.engine._split_stats(elapsed_ms / n_steps)] * n_steps)
+        return tokens, key
+
+    def generate_chunks(
+        self,
+        first_token,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+        chunk: int = 32,
+        limit: int | None = None,
+        key=None,
+        emit_first: bool = False,
+    ):
+        """Generator of on-device-decoded tokens: ``chunk`` tokens per device
+        dispatch (no per-token host round trip), host code between chunks.
+        ``first_token`` is consumed first, not yielded — a host int, or a
+        device scalar from :meth:`prefill_device` (then pass its ``key`` too
+        and the stream continues without any host round trip; set
+        ``emit_first`` and the unseen first token is fetched and yielded
+        after chunk 1 is dispatched, its fetch overlapping the chunk's
+        compute). One PRNG key
+        threads through the chunks and is split once per step, so the stream
+        for a given seed is identical to ``generate_on_device(seed)``
+        regardless of chunk size.
+
+        ``limit`` stops dispatching once ``pos`` reaches it (a stop *hint*:
+        the final chunk may overshoot it — chunks keep a fixed size so XLA
+        compiles one program, not one per remaining-budget value). Callers
+        that stop consuming early (EOS, stop string, budget) MUST
+        ``rollback(pos)`` to the stream position after the last token they
+        consumed; overshot cache slots are unreachable after rollback.
+
+        This is the user-facing fast path: the stepwise ``decode_step`` loop
+        pays a host<->device round trip per token (the reference's regime,
+        src/apps/dllama/dllama.cpp:45-59), which behind a remote PJRT tunnel
+        costs more than the forward pass itself. The stream is additionally
+        PIPELINED: chunk k+1 is dispatched (seeded by chunk k's last token,
+        which never leaves the device) BEFORE chunk k's tokens are fetched,
+        so the host-fetch latency overlaps the next chunk's compute. An
+        early stop wastes at most one speculative chunk — already covered by
+        the rollback contract above.
+        """
+        engine = self.engine
+        if key is None:
+            key = jax.random.PRNGKey(seed)
+        stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
+        if self.pos >= stop:
+            if emit_first:
+                yield self._fetch_fused_first(first_token)
+            return
+        k = min(chunk, engine.cfg.seq_len - self.pos)
+        if isinstance(first_token, (int, np.integer)):
+            first_token = int(first_token)
+        # a speculative chunk is in flight for the rest of the loop: the
+        # transfer estimate must not re-measure while one is queued, so the
+        # depth must rise BEFORE the first dispatch (a concurrent stream's
+        # probe could otherwise slip between dispatch and increment and time
+        # this chunk's compute); the finally covers early consumer exits
+        # (EOS/stop breaks close the generator)
+        with engine._depth_lock:
+            engine._pipeline_depth += 1
+        try:
+            pending, key = self._dispatch_chunk(first_token, k, temperature, topp, key)
+            pending_n = k
+            if emit_first:
+                # chunk 1 is already in flight: this scalar fetch overlaps
+                # its compute instead of gating the prompt→first-token path
+                yield self._fetch_fused_first(first_token)
+            yield from self._generate_chunks_pipelined(
+                pending, pending_n, stop, chunk, temperature, topp, key
+            )
+        finally:
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+
+    def fetch_first_token(self, first_token) -> int:
+        """Fetch a :meth:`prefill_device` token WITHOUT starting a decode
+        stream (the 1-token-completion fast path: dispatching a speculative
+        chunk would burn a whole chunk of device compute for a request that
+        wants exactly one token). Drains the prefill and fixes up its stats
+        entry like the streaming path does."""
+        return self._fetch_fused_first(first_token)
+
+    def _fetch_fused_first(self, first_token) -> int:
+        """Fetch the device-sampled first token; the blocking fetch drains
+        the prefill's device compute, so its elapsed time is added back onto
+        the prefill's stats entry (prefill_device timed only the async
+        dispatch — without this the P line would report ~dispatch overhead
+        and the prefill compute would be misattributed to the first chunk)."""
+        start = time.perf_counter()
+        tok = int(np.asarray(first_token))
+        drained_ms = (time.perf_counter() - start) * 1000.0
+        entry = self._pending_prefill_entry
+        if entry is not None:
+            entry.generation_ms += drained_ms
+            entry.inference_ms += drained_ms
+            self._pending_prefill_entry = None
+        return tok
+
+    def _generate_chunks_pipelined(
+        self, pending, pending_n, stop, chunk, temperature, topp, key
+    ):
+        engine = self.engine
+        while True:
+            # the timed window covers dispatch+fetch only — consumer time
+            # between yields must not be attributed to the engine's stats
+            start = time.perf_counter()
+            # speculatively dispatch the next chunk off the device-resident
+            # last token before fetching the pending one
+            if self.pos < stop:
+                k = min(chunk, engine.cfg.seq_len - self.pos)
+                nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
+            else:
+                nxt, k = None, 0
+            try:
+                # start the device->host copy without blocking: behind a
+                # remote PJRT tunnel the blocking fetch pays a full round
+                # trip; enqueued here it overlaps the next chunk's compute
+                pending.copy_to_host_async()
+            except Exception:
+                pass  # optional acceleration; np.asarray below is the contract
+            toks = np.asarray(pending)
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            self.stats.extend([engine._split_stats(elapsed_ms / pending_n)] * pending_n)
+            for t in toks.tolist():
+                yield int(t)
+            if nxt is None:
+                return
+            pending, pending_n = nxt, k
+
+    def stream_decode(
+        self,
+        first_token,
+        on_token,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+        chunk: int = 32,
+        limit: int | None = None,
+        key=None,
+        first_prev: int | None = None,
+    ) -> int:
+        """Drive the chunked fast decode with host-side stop handling: the
+        shared consumption loop of CLI generate/chat and the API server.
+
+        ``on_token(prev_token, token) -> bool`` is called once per decoded
+        token (False = stop). This method owns the early-stop rollback
+        contract of :meth:`generate_chunks`: every decoded token counts one
+        feed of its predecessor, so on exit the stream position is rewound to
+        just after the last decoded token's feed. Returns the number of
+        decoded tokens.
+
+        ``first_prev`` (prefill→decode fusion): ``first_token`` is a device
+        scalar from :meth:`prefill_device` that the caller has NOT seen yet —
+        it is ALSO yielded to ``on_token`` as the first decoded token (its
+        host value arrives with the first fetched chunk), with ``first_prev``
+        (the prompt's last token) as its predecessor."""
+        start_pos = self.pos
+        consumed = 0
+        fused_first = first_prev is not None
+        prev = first_prev if fused_first else int(first_token)
+        for t in self.generate_chunks(
+            first_token, temperature, topp, seed=seed, chunk=chunk, limit=limit,
+            key=key, emit_first=fused_first,
+        ):
+            consumed += 1
+            keep_going = on_token(prev, t)
+            prev = t
+            # with a fused first token, yield i corresponds to stream
+            # position start_pos + i - 1 (the first yield was sampled during
+            # prefill and occupies no new position until fed)
+            fed = consumed - 1 if fused_first else consumed
+            if keep_going is False:
+                break
+            if limit is not None and start_pos + fed >= limit:
+                break
+        fed = max(consumed - 1, 0) if fused_first else consumed
+        self.rollback(start_pos + fed)
+        return consumed
+
+    # ------------------------------------------------------------------
+    # Stats (reference: Inference::getStats, src/tasks.cpp:186-189)
+    # ------------------------------------------------------------------
+
+    def avg_stats(self) -> TokenStats:
+        """Per-token averages, weighting batched-prefill entries by their
+        token count (the reference accounts per position, dllama.cpp:88-93)."""
+        if not self.stats:
+            return TokenStats(0.0, 0.0, 0.0)
+        n = sum(s.n_tokens for s in self.stats)
+        return TokenStats(
+            sum(s.generation_ms for s in self.stats) / n,
+            sum(s.inference_ms for s in self.stats) / n,
+            sum(s.transfer_ms for s in self.stats) / n,
+            n_tokens=n,
+        )
+
+    def total_tokens(self) -> int:
+        return sum(s.n_tokens for s in self.stats)
+
+
 class InferenceEngine:
     """Single-program driver for one model instance.
 
     ``tp`` > 1 shards the same forward over a tensor-parallel mesh
     (see distributed_llama_tpu.parallel); tp=1 is the single-chip path.
+    The engine exposes the classic single-stream surface (prefill/decode/
+    stats) by delegating to a default :class:`EngineStream`;
+    :meth:`new_stream` adds independent concurrent streams over the same
+    weights.
     """
 
     def __init__(
@@ -114,19 +560,100 @@ class InferenceEngine:
         reader.close()
         if self._tp_engine is not None:
             self.params = self._tp_engine.shard_params(host_params)
-            self.cache = self._tp_engine.init_cache(self.cache_dtype)
             self._forward = self._tp_engine.forward
         else:
             self.params = jax.device_put(host_params)
-            # per-layer cache list matching the per-layer params list, so
-            # cache updates alias in place (see llama.init_cache)
-            self.cache = llama.init_cache(self.cfg, dtype=self.cache_dtype, layered=True)
             self._forward = functools.partial(self._forward_single, self.cfg)
-        self.pos = 0
-        self.stats: list[TokenStats] = []
+        self._streams: list[EngineStream] = []
+        self._default = EngineStream(self, self._new_cache())
         self._transfer_ms: float | None = None  # measured lazily under TP/SP
         self._transfer_measured_at = 0  # token count at the last measurement
         self._pipeline_depth = 0  # >0 while a speculative chunk is in flight
+        # concurrent streams (API --parallel) bump the depth from several
+        # threads; the counter must not lose updates or go negative (a stuck
+        # >0 would freeze the transfer estimate, a negative one would let
+        # probes run mid-flight)
+        self._depth_lock = threading.Lock()
+
+    def _new_cache(self):
+        if self._tp_engine is not None:
+            return self._tp_engine.init_cache(self.cache_dtype)
+        # per-layer cache list matching the per-layer params list, so
+        # cache updates alias in place (see llama.init_cache)
+        return llama.init_cache(self.cfg, dtype=self.cache_dtype, layered=True)
+
+    def new_stream(self) -> EngineStream:
+        """An independent generation stream (own KV cache + position) over
+        this engine's weights. Each stream costs one KV cache of HBM."""
+        return EngineStream(self, self._new_cache())
+
+    @property
+    def default_stream(self) -> EngineStream:
+        return self._default
+
+    # ------------------------------------------------------------------
+    # Single-stream delegation (the classic engine surface)
+    # ------------------------------------------------------------------
+
+    @property
+    def pos(self) -> int:
+        return self._default.pos
+
+    @pos.setter
+    def pos(self, value: int) -> None:
+        self._default.pos = value
+
+    @property
+    def cache(self):
+        return self._default.cache
+
+    @cache.setter
+    def cache(self, value) -> None:
+        self._default.cache = value
+
+    @property
+    def stats(self) -> list[TokenStats]:
+        return self._default.stats
+
+    def reset(self) -> None:
+        self._default.reset()
+
+    def rollback(self, pos: int) -> None:
+        self._default.rollback(pos)
+
+    def forward(self, tokens) -> np.ndarray:
+        return self._default.forward(tokens)
+
+    def prefill(self, tokens) -> np.ndarray:
+        return self._default.prefill(tokens)
+
+    def prefill_device(self, tokens, temperature, topp, seed: int):
+        return self._default.prefill_device(tokens, temperature, topp, seed)
+
+    def decode_step(self, token: int) -> np.ndarray:
+        return self._default.decode_step(token)
+
+    def generate_on_device(self, *args, **kwargs) -> np.ndarray:
+        return self._default.generate_on_device(*args, **kwargs)
+
+    def decode_chunk(self, *args, **kwargs):
+        return self._default.decode_chunk(*args, **kwargs)
+
+    def generate_chunks(self, *args, **kwargs):
+        return self._default.generate_chunks(*args, **kwargs)
+
+    def stream_decode(self, *args, **kwargs) -> int:
+        return self._default.stream_decode(*args, **kwargs)
+
+    def avg_stats(self) -> TokenStats:
+        return self._default.avg_stats()
+
+    def total_tokens(self) -> int:
+        return self._default.total_tokens()
+
+    # ------------------------------------------------------------------
+    # Shared internals
+    # ------------------------------------------------------------------
 
     # decoded tokens between transfer re-measurements: the estimate follows
     # actual interconnect load over a session for the cost of one tiny
@@ -138,28 +665,36 @@ class InferenceEngine:
         """Per-dispatch collective cost: 0 on a single chip; under TP/SP
         measured on the real mesh and re-measured periodically in situ.
 
-        Refreshes happen only at QUIESCENT points (no dispatch in flight):
-        inside the pipelined chunk loop a probe would queue behind the
-        in-flight chunk and time its compute, poisoning the very split it
-        feeds. The prefill/forward/decode_chunk paths all reach here right
-        after their own fetch drained the stream, so every API request and
-        every stepwise loop refreshes on cadence; generate_chunks reuses
+        Refreshes happen only at QUIESCENT points (no dispatch in flight on
+        ANY stream): inside the pipelined chunk loop a probe would queue
+        behind the in-flight chunk and time its compute, poisoning the very
+        split it feeds. The prefill/forward/decode_chunk paths all reach here
+        right after their own fetch drained the stream, so every API request
+        and every stepwise loop refreshes on cadence; generate_chunks reuses
         the last measurement."""
         if self._tp_engine is None:
             return 0.0
-        if self._pipeline_depth > 0:
-            # never measure mid-flight (even the FIRST time — a caller whose
-            # first op is generate_chunks would otherwise cache a poisoned
-            # estimate); report 0 until a quiescent call measures
-            return self._transfer_ms or 0.0
-        n = sum(s.n_tokens for s in self.stats)
-        if (
-            self._transfer_ms is None
-            or n - self._transfer_measured_at >= self.TRANSFER_REFRESH_TOKENS
-        ):
-            self._transfer_ms = self._tp_engine.measure_transfer_ms()
-            self._transfer_measured_at = n
-        return self._transfer_ms
+        # the depth check and the probe run under the SAME lock that
+        # dispatchers raise the depth under, so a concurrent stream cannot
+        # enqueue a chunk between the check and the measurement (the probe
+        # would queue behind it and time its compute); dispatchers briefly
+        # block on the lock during a refresh (~once per 512 tokens)
+        with self._depth_lock:
+            if self._pipeline_depth > 0:
+                # never measure mid-flight (even the FIRST time — a caller
+                # whose first op is generate_chunks would otherwise cache a
+                # poisoned estimate); report 0 until a quiescent call measures
+                return self._transfer_ms or 0.0
+            # cadence counts tokens across ALL streams: API traffic on
+            # non-default slots must still drive the periodic re-measurement
+            n = sum(s.n_tokens for st in self._streams for s in st.stats)
+            if (
+                self._transfer_ms is None
+                or n - self._transfer_measured_at >= self.TRANSFER_REFRESH_TOKENS
+            ):
+                self._transfer_ms = self._tp_engine.measure_transfer_ms()
+                self._transfer_measured_at = n
+            return self._transfer_ms
 
     def _last_dispatches(self) -> int:
         """How many device programs the most recent forward issued (the sp
@@ -181,304 +716,21 @@ class InferenceEngine:
             per_entry_ms, per_entry_ms - transfer, transfer, n_tokens=n_tokens
         )
 
+    def _sample_row(self, logits, row, sub, temperature, topp):
+        """Sample from one row of device logits entirely on device (the
+        prefill→decode fusion: no logits fetch). Under TP/SP the logits
+        returned by the backend's forward are already full-vocab and
+        replicated, so a replicated sample is correct on every backend."""
+        return _sample_row_jit(logits, row, sub, temperature, topp)
+
     @staticmethod
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
     def _forward_single(cfg: LlamaConfig, params, tokens, cache, pos):
         return llama.forward_tokens(cfg, params, tokens, cache, pos)
 
-    # ------------------------------------------------------------------
-    # Generation API
-    # ------------------------------------------------------------------
 
-    def reset(self) -> None:
-        self.pos = 0
-        self.stats.clear()
-        # keep the last transfer measurement (still valid) but restart the
-        # refresh cadence with the cleared token count
-        self._transfer_measured_at = 0
+@jax.jit
+def _sample_row_jit(logits, row, sub, temperature, topp):
+    from distributed_llama_tpu.models import sampling
 
-    def rollback(self, pos: int) -> None:
-        """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots
-        beyond ``pos`` are stale but unreachable: attention masks s <= pos and
-        every slot is overwritten before the position pointer crosses it."""
-        if not 0 <= pos <= self.pos:
-            raise ValueError(f"cannot rollback to {pos} from {self.pos}")
-        self.pos = pos
-
-    def _forward_device(self, tokens: np.ndarray):
-        """Dispatch one forward; returns DEVICE logits [T_padded, vocab].
-        Advances pos and records stats (the timing covers dispatch only —
-        callers append their fetch to the same stats entry implicitly by
-        measuring around their np.asarray)."""
-        n = tokens.shape[0]
-        if n == 0:
-            raise ValueError("empty token batch: at least one token required")
-        if self.pos + n > self.cfg.seq_len:
-            raise ValueError(f"context overflow: pos {self.pos} + {n} > {self.cfg.seq_len}")
-        if n == 1 or (
-            # backends that chunk mid-context prompts themselves (sp) pad to
-            # their own fixed chunk width — engine bucket-padding on top
-            # would only inflate the dispatch count
-            self.pos > 0
-            and getattr(self._tp_engine, "prefers_exact_mid_prefill", False)
-        ):
-            padded = tokens
-        else:
-            bucket = _prefill_bucket(n)
-            if self.pos + bucket > self.cfg.seq_len:
-                bucket = n  # exact-length compile near the context limit
-            padded = np.zeros(bucket, dtype=np.int32)
-            padded[:n] = tokens
-        logits, self.cache = self._forward(
-            self.params, jnp.asarray(padded), self.cache, jnp.int32(self.pos)
-        )
-        self.pos += n
-        return logits
-
-    def forward(self, tokens: list[int] | np.ndarray) -> np.ndarray:
-        """Run tokens at the current position; returns f32 logits [T, vocab]
-        (padded positions stripped). Advances pos by len(tokens)."""
-        tokens = np.asarray(tokens, dtype=np.int32)
-        n = tokens.shape[0]
-        start = time.perf_counter()
-        logits = np.asarray(self._forward_device(tokens)[:n])
-        elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(
-            self._split_stats(elapsed, n_tokens=n, n_dispatches=self._last_dispatches())
-        )
-        return logits
-
-    def prefill(self, tokens: list[int]) -> np.ndarray:
-        """Process a prompt in one batched step; returns last-token logits.
-
-        Only the LAST position's logits row cross the host boundary: a
-        64-token prefill of a 32k-vocab model would otherwise ship 8 MB of
-        f32 logits per prompt (measured ~2 s through a remote PJRT tunnel
-        vs ~tens of ms for the row)."""
-        tokens = np.asarray(tokens, dtype=np.int32)
-        n = tokens.shape[0]
-        start = time.perf_counter()
-        logits = np.asarray(self._forward_device(tokens)[n - 1])
-        elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(
-            self._split_stats(elapsed, n_tokens=n, n_dispatches=self._last_dispatches())
-        )
-        return logits
-
-    def decode_step(self, token: int) -> np.ndarray:
-        """One autoregressive step; returns f32 logits [vocab]."""
-        return self.forward([token])[0]
-
-    def generate_on_device(
-        self,
-        first_token: int,
-        n_steps: int,
-        temperature: float = 0.0,
-        topp: float = 0.9,
-        seed: int = 0,
-    ) -> np.ndarray:
-        """Generate n_steps tokens in ONE device program (no per-token host
-        round trip). Returns int32 [n_steps]. Under TP the loop is
-        shard_map'd over the mesh with collectives riding every step."""
-        if self.pos + n_steps > self.cfg.seq_len:
-            raise ValueError(f"context overflow: pos {self.pos} + {n_steps}")
-        from distributed_llama_tpu.models import sampling
-
-        start = time.perf_counter()
-        if self._tp_engine is not None:
-            tokens, self.cache = self._tp_engine.decode_loop(
-                self.params,
-                jnp.int32(first_token),
-                self.cache,
-                jnp.int32(self.pos),
-                n_steps,
-                float(temperature),
-                float(topp),
-                jax.random.PRNGKey(seed),
-            )
-        else:
-            tokens, self.cache = sampling.decode_loop(
-                self.cfg,
-                self.params,
-                jnp.int32(first_token),
-                self.cache,
-                jnp.int32(self.pos),
-                n_steps,
-                float(temperature),
-                float(topp),
-                jax.random.PRNGKey(seed),
-            )
-        tokens = np.asarray(tokens)
-        elapsed_ms = (time.perf_counter() - start) * 1000.0
-        self.stats.extend([self._split_stats(elapsed_ms / n_steps)] * n_steps)
-        self.pos += n_steps
-        return tokens
-
-    def _dispatch_chunk(self, first_token, n_steps: int, temperature, topp, key):
-        """Dispatch one decode chunk WITHOUT fetching: returns the device
-        token array and the advanced key. ``first_token`` may be a host int
-        or a device scalar (the previous chunk's last token — the pipelined
-        path never waits on it). Advances pos by n_steps."""
-        from distributed_llama_tpu.models import sampling
-
-        if self._tp_engine is not None:
-            tokens, self.cache, key = self._tp_engine.decode_chunk(
-                self.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
-                n_steps, temperature, topp, key,
-            )
-        else:
-            tokens, self.cache, key = sampling.decode_chunk(
-                self.cfg, self.params, jnp.int32(first_token), self.cache,
-                jnp.int32(self.pos), n_steps, jnp.float32(temperature),
-                jnp.float32(topp), key,
-            )
-        self.pos += n_steps
-        return tokens, key
-
-    def decode_chunk(self, first_token: int, n_steps: int, temperature, topp, key):
-        """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
-        temperature/topp (no recompile when a request changes them). Returns
-        (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
-        start = time.perf_counter()
-        tokens, key = self._dispatch_chunk(first_token, n_steps, temperature, topp, key)
-        tokens = np.asarray(tokens)
-        elapsed_ms = (time.perf_counter() - start) * 1000.0
-        self.stats.extend([self._split_stats(elapsed_ms / n_steps)] * n_steps)
-        return tokens, key
-
-    def generate_chunks(
-        self,
-        first_token: int,
-        temperature: float = 0.0,
-        topp: float = 0.9,
-        seed: int = 0,
-        chunk: int = 32,
-        limit: int | None = None,
-    ):
-        """Generator of on-device-decoded tokens: ``chunk`` tokens per device
-        dispatch (no per-token host round trip), host code between chunks.
-        ``first_token`` is consumed first, not yielded. One PRNG key threads
-        through the chunks and is split once per step, so the stream for a
-        given seed is identical to ``generate_on_device(seed)`` regardless of
-        chunk size.
-
-        ``limit`` stops dispatching once ``pos`` reaches it (a stop *hint*:
-        the final chunk may overshoot it — chunks keep a fixed size so XLA
-        compiles one program, not one per remaining-budget value). Callers
-        that stop consuming early (EOS, stop string, budget) MUST
-        ``rollback(pos)`` to the stream position after the last token they
-        consumed; overshot cache slots are unreachable after rollback.
-
-        This is the user-facing fast path: the stepwise ``decode_step`` loop
-        pays a host<->device round trip per token (the reference's regime,
-        src/apps/dllama/dllama.cpp:45-59), which behind a remote PJRT tunnel
-        costs more than the forward pass itself. The stream is additionally
-        PIPELINED: chunk k+1 is dispatched (seeded by chunk k's last token,
-        which never leaves the device) BEFORE chunk k's tokens are fetched,
-        so the host-fetch latency overlaps the next chunk's compute. An
-        early stop wastes at most one speculative chunk — already covered by
-        the rollback contract above.
-        """
-        key = jax.random.PRNGKey(seed)
-        stop = self.cfg.seq_len if limit is None else min(limit, self.cfg.seq_len)
-        if self.pos >= stop:
-            return
-        k = min(chunk, self.cfg.seq_len - self.pos)
-        pending, key = self._dispatch_chunk(int(first_token), k, temperature, topp, key)
-        pending_n = k
-        # a speculative chunk is in flight for the rest of the loop: the
-        # transfer estimate must not re-measure here (see
-        # _transfer_ms_per_token); the generator's finally covers early
-        # consumer exits (EOS/stop breaks close the generator)
-        self._pipeline_depth += 1
-        try:
-            yield from self._generate_chunks_pipelined(
-                pending, pending_n, stop, chunk, temperature, topp, key
-            )
-        finally:
-            self._pipeline_depth -= 1
-
-    def _generate_chunks_pipelined(
-        self, pending, pending_n, stop, chunk, temperature, topp, key
-    ):
-        while True:
-            # the timed window covers dispatch+fetch only — consumer time
-            # between yields must not be attributed to the engine's stats
-            start = time.perf_counter()
-            # speculatively dispatch the next chunk off the device-resident
-            # last token before fetching the pending one
-            if self.pos < stop:
-                k = min(chunk, self.cfg.seq_len - self.pos)
-                nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
-            else:
-                nxt, k = None, 0
-            try:
-                # start the device->host copy without blocking: behind a
-                # remote PJRT tunnel the blocking fetch pays a full round
-                # trip; enqueued here it overlaps the next chunk's compute
-                pending.copy_to_host_async()
-            except Exception:
-                pass  # optional acceleration; np.asarray below is the contract
-            toks = np.asarray(pending)
-            elapsed_ms = (time.perf_counter() - start) * 1000.0
-            self.stats.extend([self._split_stats(elapsed_ms / pending_n)] * pending_n)
-            for t in toks.tolist():
-                yield int(t)
-            if nxt is None:
-                return
-            pending, pending_n = nxt, k
-
-    def stream_decode(
-        self,
-        first_token: int,
-        on_token,
-        temperature: float = 0.0,
-        topp: float = 0.9,
-        seed: int = 0,
-        chunk: int = 32,
-        limit: int | None = None,
-    ) -> int:
-        """Drive the chunked fast decode with host-side stop handling: the
-        shared consumption loop of CLI generate/chat and the API server.
-
-        ``on_token(prev_token, token) -> bool`` is called once per decoded
-        token (False = stop). This method owns the early-stop rollback
-        contract of :meth:`generate_chunks`: every decoded token counts one
-        feed of its predecessor, so on exit the stream position is rewound to
-        just after the last decoded token's feed. Returns the number of
-        decoded tokens."""
-        start_pos = self.pos
-        consumed = 0
-        prev = int(first_token)
-        for t in self.generate_chunks(
-            first_token, temperature, topp, seed=seed, chunk=chunk, limit=limit
-        ):
-            consumed += 1
-            keep_going = on_token(prev, t)
-            prev = t
-            if keep_going is False:
-                break
-            if limit is not None and start_pos + consumed >= limit:
-                break
-        self.rollback(start_pos + consumed)
-        return consumed
-
-    # ------------------------------------------------------------------
-    # Stats (reference: Inference::getStats, src/tasks.cpp:186-189)
-    # ------------------------------------------------------------------
-
-    def avg_stats(self) -> TokenStats:
-        """Per-token averages, weighting batched-prefill entries by their
-        token count (the reference accounts per position, dllama.cpp:88-93)."""
-        if not self.stats:
-            return TokenStats(0.0, 0.0, 0.0)
-        n = sum(s.n_tokens for s in self.stats)
-        return TokenStats(
-            sum(s.generation_ms for s in self.stats) / n,
-            sum(s.inference_ms for s in self.stats) / n,
-            sum(s.transfer_ms for s in self.stats) / n,
-            n_tokens=n,
-        )
-
-    def total_tokens(self) -> int:
-        return sum(s.n_tokens for s in self.stats)
+    return sampling.sample_token(logits[row], sub, temperature, topp)

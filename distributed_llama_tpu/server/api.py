@@ -3,8 +3,17 @@
 Behavior parity with the reference's dllama-api
 (reference: src/apps/dllama-api/dllama-api.cpp): SSE streaming chunks
 (:168-185), per-request temperature/seed/max_tokens overrides (:351-380),
-the NaiveCache longest-message-prefix KV reuse (:187-241), single in-flight
-request, and the same response JSON shapes (types.hpp:10-147).
+the NaiveCache longest-message-prefix KV reuse (:187-241), and the same
+response JSON shapes (types.hpp:10-147).
+
+Beyond the reference, completions are CONCURRENT: ``--parallel N`` (default
+2) serves N in-flight completions on one engine, each on its own
+:class:`~distributed_llama_tpu.engine.engine.EngineStream` (own KV cache +
+prefix cache; weights and compiled programs shared). Requests are assigned
+to the free stream whose chat-prefix cache matches best, so multi-turn
+conversations keep their KV reuse under concurrency. The reference is
+architecturally single-stream — one socket accept drives one inference at a
+time (dllama-api.cpp:418-423).
 
 Intentional fixes over the reference:
 * request ``stop`` sequences are actually honored (the reference parses them
@@ -72,57 +81,114 @@ class NaiveCache:
 
     def resolve_delta_prompt(self, messages: list[dict]) -> tuple[int, list[dict]]:
         """Returns (start_pos, remaining_messages)."""
-        n = len(self.items)
-        if n == 0:
+        if self.match_len(messages) == 0:
+            self.clear()
             return 0, messages
-        if len(messages) > n and all(
+        return self.items[-1].end_pos, messages[len(self.items):]
+
+    def match_len(self, messages: list[dict]) -> int:
+        """Number of cached messages this request would reuse (0 = no reuse).
+        Non-mutating — the slot scheduler scores free streams with it."""
+        n = len(self.items)
+        if n == 0 or len(messages) <= n:
+            return 0
+        if all(
             self.items[i].role == messages[i]["role"]
             and self.items[i].content == messages[i]["content"]
             for i in range(n)
         ):
-            return self.items[-1].end_pos, messages[n:]
-        self.clear()
-        return 0, messages
+            return n
+        return 0
+
+
+@dataclasses.dataclass
+class StreamSlot:
+    """One concurrent completion lane: an engine stream plus its chat-prefix
+    cache and (host-path) sampler. ``busy`` is guarded by ApiState._mutex."""
+
+    stream: object  # EngineStream
+    cache: NaiveCache
+    sampler: Sampler
+    busy: bool = False
 
 
 class ApiState:
     def __init__(self, engine, tokenizer: Tokenizer, sampler: Sampler, args):
         self.engine = engine
         self.tokenizer = tokenizer
-        self.sampler = sampler
+        self.sampler = sampler  # slot 0's sampler (kept as an attribute for tests)
         self.args = args
         stops = chat_stops(tokenizer)
         self.stops = stops
         template_type = getattr(args, "chat_template", None) or ChatTemplateType.UNKNOWN
         self.template = ChatTemplate(template_type, tokenizer.chat_template, stops[0])
-        self.cache = NaiveCache()
-        # one engine, one stream position: completions are strictly
-        # serialized. The reference is single-threaded by construction
-        # (dllama-api.cpp:418-423 accepts one socket at a time); here the
-        # HTTP layer is threaded (GET /v1/models answers during a live
-        # generation) so the serialization is an explicit lock.
-        self.lock = threading.Lock()
+        # N concurrent completion lanes over one engine (each stream owns a
+        # KV cache; weights/compiled programs are shared). The reference is
+        # single-threaded by construction (dllama-api.cpp:418-423 accepts
+        # one socket at a time).
+        n = max(1, int(getattr(args, "parallel", 2) or 1))
+        streams = [engine.default_stream] + [engine.new_stream() for _ in range(n - 1)]
+        self.slots = [
+            StreamSlot(
+                s,
+                NaiveCache(),
+                sampler if i == 0 else Sampler(
+                    vocab_size=sampler.vocab_size, temperature=sampler.temperature,
+                    topp=sampler.topp, seed=sampler.seed + i,
+                ),
+            )
+            for i, s in enumerate(streams)
+        ]
+        self.cache = self.slots[0].cache  # single-stream tests poke this
+        self._mutex = threading.Lock()
+        self._free = threading.Semaphore(n)
+
+    def _acquire_slot(self, messages: list[dict]) -> StreamSlot:
+        """Block until a lane is free, then take the free lane whose chat
+        prefix cache reuses the most of this request (prefix affinity keeps
+        multi-turn KV reuse working under concurrency)."""
+        self._free.acquire()
+        with self._mutex:
+            free = [s for s in self.slots if not s.busy]
+            # primary: longest prefix reuse; tie-break: prefer an EMPTY
+            # cache so a fresh conversation does not clobber another live
+            # conversation's prefix cache when an empty lane exists
+            slot = max(
+                free,
+                key=lambda s: (s.cache.match_len(messages), 0 if s.cache.items else 1),
+            )
+            slot.busy = True
+            return slot
+
+    def _release_slot(self, slot: StreamSlot) -> None:
+        with self._mutex:
+            slot.busy = False
+        self._free.release()
 
     def complete(self, body: dict, send_chunk, params: dict | None = None) -> dict | None:
         """Run one completion. ``send_chunk(str)`` streams SSE data lines when
         the request has stream=true (then returns None); otherwise returns the
-        final JSON payload. Concurrent calls queue on the engine lock.
+        final JSON payload. Up to ``--parallel`` calls run concurrently, each
+        on its own stream; excess calls queue.
         ``params``: the pre-validated result of :meth:`_parse` (the handler
         validates before sending SSE headers, so validation runs once)."""
         if params is None:
             params = self._parse(body)
-        with self.lock:
-            return self._complete_locked(params, send_chunk)
+        slot = self._acquire_slot(params["messages"])
+        try:
+            return self._complete_on(slot, params, send_chunk)
+        finally:
+            self._release_slot(slot)
 
-    def _complete_locked(self, params: dict, send_chunk) -> dict | None:
-        engine, tokenizer = self.engine, self.tokenizer
+    def _complete_on(self, slot: StreamSlot, params: dict, send_chunk) -> dict | None:
+        engine, tokenizer = slot.stream, self.tokenizer
         stream = params["stream"]
 
-        start_pos, delta_messages = self.cache.resolve_delta_prompt(params["messages"])
+        start_pos, delta_messages = slot.cache.resolve_delta_prompt(params["messages"])
         engine.rollback(min(start_pos, engine.pos))
         if engine.pos != start_pos:  # cache said resume further than engine state
             engine.reset()
-            self.cache.clear()  # stale end_pos values no longer map to engine positions
+            slot.cache.clear()  # stale end_pos values no longer map to engine positions
             start_pos = 0
             delta_messages = params["messages"]
 
@@ -141,16 +207,28 @@ class ApiState:
             prompt_tokens = prompt_tokens[:budget]
         prompt_end = start_pos + len(prompt_tokens)
         for m in delta_messages:
-            self.cache.push(prompt_end, m["role"], m["content"])
+            slot.cache.push(prompt_end, m["role"], m["content"])
 
         max_pos = prompt_end + params["max_tokens"] if params["max_tokens"] > 0 else seq_len
         max_pos = min(max_pos, seq_len)
 
-        self.sampler.set_temperature(params["temperature"])
+        slot.sampler.set_temperature(params["temperature"])
         if params["seed"] is not None:
-            self.sampler.set_seed(params["seed"])
+            slot.sampler.set_seed(params["seed"])
 
-        logits = engine.prefill(prompt_tokens)
+        device_decode = getattr(self.args, "decode", "device") == "device"
+        seed = params["seed"]
+        if seed is None:
+            seed = int(time.time_ns() % (1 << 31))
+        if device_decode:
+            # prefill→decode fusion: the first generated token is sampled on
+            # device and never visits the host before chunk 1 is dispatched —
+            # one tunnel round trip per request instead of two (docs/PERF.md)
+            first_dev, chunk_key = engine.prefill_device(
+                prompt_tokens, params["temperature"], self.args.topp, seed
+            )
+        else:
+            logits = engine.prefill(prompt_tokens)
 
         max_stop = max(len(s) for s in self.stops + params["stop"]) if (self.stops or params["stop"]) else 0
         detector = EosDetector(
@@ -183,19 +261,19 @@ class ApiState:
         # zero budget (prompt fills the remaining context) emits nothing
         max_new = max_pos - prompt_end
         res = EosDetectorResult.NOT_EOS
-        if max_new > 0:
-            token = self.sampler.sample(logits)  # first token: host sampler
-            res = feed(prompt_tokens[-1], token)
-        if res == EosDetectorResult.EOS:
-            finish_reason = "stop"
-        elif emitted < max_new and engine.pos < seq_len:
-            if getattr(self.args, "decode", "device") == "device":
-                # fast path: chunked on-device decode+sampling; temperature
-                # and top-p are runtime values (no per-request recompile)
-                seed = params["seed"]
-                if seed is None:
-                    seed = int(time.time_ns() % (1 << 31))
-
+        if device_decode:
+            if max_new == 1:
+                # 1-token completion: fetch the fused token directly — a
+                # decode stream would dispatch a whole speculative chunk
+                # whose output is discarded
+                token = engine.fetch_first_token(first_dev)
+                res = feed(prompt_tokens[-1], token)
+                if res == EosDetectorResult.EOS:
+                    finish_reason = "stop"
+            elif max_new > 0:
+                # fast path: chunked on-device decode+sampling (temperature
+                # and top-p are runtime values — no per-request recompile);
+                # the fused first token arrives with the stream
                 def on_token(prev: int, t: int) -> bool:
                     nonlocal res, finish_reason
                     res = feed(prev, t)
@@ -205,15 +283,21 @@ class ApiState:
                     return emitted < max_new
 
                 engine.stream_decode(
-                    token, on_token, params["temperature"], self.args.topp,
+                    first_dev, on_token, params["temperature"], self.args.topp,
                     seed=seed, chunk=getattr(self.args, "decode_chunk", 32),
-                    limit=max_pos,
+                    limit=max_pos, key=chunk_key, first_prev=prompt_tokens[-1],
                 )
-            else:
+        else:
+            if max_new > 0:
+                token = slot.sampler.sample(logits)  # first token: host sampler
+                res = feed(prompt_tokens[-1], token)
+            if res == EosDetectorResult.EOS:
+                finish_reason = "stop"
+            elif emitted < max_new and engine.pos < seq_len:
                 while emitted < max_new and engine.pos < seq_len:
                     prev = token
                     logits = engine.decode_step(prev)
-                    token = self.sampler.sample(logits)
+                    token = slot.sampler.sample(logits)
                     res = feed(prev, token)
                     if res == EosDetectorResult.EOS:
                         finish_reason = "stop"
@@ -230,9 +314,9 @@ class ApiState:
 
         content = "".join(buffer)
         if engine.pos >= seq_len:
-            self.cache.clear()  # (reference: dllama-api.cpp:330-334)
+            slot.cache.clear()  # (reference: dllama-api.cpp:330-334)
         else:
-            self.cache.push(engine.pos, "assistant", content)
+            slot.cache.push(engine.pos, "assistant", content)
 
         if stream:
             send_chunk(
@@ -433,7 +517,8 @@ def serve(args) -> None:
     engine, tokenizer, sampler = make_engine(args)
     state = ApiState(engine, tokenizer, sampler, args)
     # threaded HTTP front (GET /v1/models and queued POSTs stay responsive);
-    # completions themselves serialize on state.lock
+    # up to --parallel completions run concurrently on their own engine
+    # streams, excess requests queue on the slot semaphore (ApiState._free)
     server = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(state))
     server.daemon_threads = True
     print(f"Server URL: http://127.0.0.1:{args.port}/v1/")
@@ -451,6 +536,11 @@ def main(argv=None) -> None:
     enable_compilation_cache()
     parser = build_parser()
     parser.add_argument("--port", type=int, default=9990)
+    parser.add_argument(
+        "--parallel", type=int, default=2,
+        help="concurrent in-flight completions (each costs one KV cache of "
+        "HBM; the reference serves exactly one, dllama-api.cpp:418-423)",
+    )
     # mode is meaningless here but the shared parser requires it
     argv = argv if argv is not None else None
     import sys

@@ -223,14 +223,77 @@ class TestApiHardening:
             assert r["object"] == "chat.completion"
             assert r["usage"]["completion_tokens"] <= 4
 
+    def test_two_concurrent_streams_interleave(self, served):
+        """Two SSE completions must be in flight AT THE SAME TIME, each on
+        its own engine stream — the capability the reference cannot have
+        (its accept loop drives one inference at a time,
+        dllama-api.cpp:418-423). Request A is paused mid-stream by its SSE
+        consumer; request B must start AND finish during the pause, which is
+        only possible if B runs on a second concurrent stream."""
+        url, state = served
+        if len(state.slots) < 2:
+            pytest.skip("server configured single-stream")
+        for slot in state.slots:
+            slot.stream.reset()
+            slot.cache.clear()
+
+        a_first_chunk = threading.Event()
+        b_done = threading.Event()
+        a_result = {}
+
+        def run_a():
+            chunks = []
+
+            def send(data):
+                chunks.append(data)
+                if len(chunks) == 1:
+                    a_first_chunk.set()
+                    # hold A open until B has finished end-to-end
+                    assert b_done.wait(timeout=60), "B never completed while A was open"
+
+            state.complete(
+                {"stream": True,
+                 "messages": [{"role": "user", "content": "hello a"}],
+                 "max_tokens": 4},
+                send,
+            )
+            a_result["chunks"] = chunks
+
+        ta = threading.Thread(target=run_a)
+        ta.start()
+        assert a_first_chunk.wait(timeout=60)
+        # A is mid-stream and holding its slot; B must complete concurrently
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with post(url, {"messages": [{"role": "user", "content": "hello b"}],
+                        "max_tokens": 8}) as r:
+            b = json.loads(r.read())
+        b_elapsed = _time.perf_counter() - t0
+        b_done.set()
+        ta.join(timeout=60)
+        assert not ta.is_alive()
+        assert b["object"] == "chat.completion"
+        assert a_result["chunks"][-1] == "[DONE]"
+        # both lanes ran: the paused A occupied one slot, so B's tokens are
+        # in a DIFFERENT stream's stats
+        streams_used = [s for s in state.slots if s.stream.total_tokens() > 0]
+        assert len(streams_used) >= 2
+        total = sum(s.stream.total_tokens() for s in state.slots)
+        print(f"aggregate: {total} tokens across {len(streams_used)} concurrent "
+              f"streams; B completed in {b_elapsed:.2f}s while A was open")
+
     def test_streaming_engine_failure_sends_error_event(self, served):
         """An engine failure mid-stream must surface as a terminal SSE error
         event, not a silently truncated stream."""
         url, state = served
         state.engine.reset()
         state.cache.clear()
-        original = state.engine.prefill
-        state.engine.prefill = lambda toks: (_ for _ in ()).throw(RuntimeError("boom"))
+        # inject the failure below every prefill entry point (the device
+        # path runs prefill_device, the host path prefill; both dispatch
+        # through engine._forward)
+        original = state.engine._forward
+        state.engine._forward = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom"))
         try:
             req = urllib.request.Request(
                 url + "/v1/chat/completions",
@@ -243,7 +306,7 @@ class TestApiHardening:
             with urllib.request.urlopen(req, timeout=30) as r:
                 raw = r.read().decode()
         finally:
-            state.engine.prefill = original
+            state.engine._forward = original
         chunks = [c[len("data: "):] for c in raw.split("\r\n\r\n") if c.startswith("data: ")]
         assert chunks, raw
         assert json.loads(chunks[0])["error"]["message"] == "boom"
