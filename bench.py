@@ -138,6 +138,17 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, prefill_len, dtype=np.int32))
 
+    # tunnel round trip: a tiny dispatch+fetch (the floor any single fetch
+    # pays through the remote PJRT tunnel; ~96-130 ms observed). Needed to
+    # report on-device prefill time from amortized runs.
+    np.asarray(jnp.zeros(4) + 1)
+    rt_samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jnp.zeros(4) + 1)
+        rt_samples.append((time.perf_counter() - t0) * 1000.0)
+    rt_ms = sorted(rt_samples)[2]
+
     t0 = time.perf_counter()
     logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(0))
     np.asarray(logits[-1])  # fetch ONE row: the serving pattern (engine.prefill);
@@ -154,6 +165,22 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
         np.asarray(logits[-1])
         warm_times.append((time.perf_counter() - t0) * 1000.0)
     prefill_warm_ms = sorted(warm_times)[1]
+
+    # ON-DEVICE prefill: K chained dispatches, ONE fence, minus one round
+    # trip — the number the hardware actually delivers (the warm single
+    # number above is dominated by the tunnel RT, which the serving path no
+    # longer pays per request: prefill_device fuses prefill→sample→chunk-1
+    # with no intermediate fetch). Median of 3.
+    K = 16
+    dev_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(K):
+            logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((i % 4) * prefill_len))
+        np.asarray(logits[-1])
+        dev_times.append(((time.perf_counter() - t0) * 1000.0 - rt_ms) / K)
+    prefill_device_ms = max(sorted(dev_times)[1], 1e-3)
+    prefill_tps = prefill_len / prefill_device_ms * 1000.0
 
     token = jnp.int32(np.argmax(np.asarray(logits[-1])))
     single_base = 4 * prefill_len  # fixed window: decode_loop replays 256..384
@@ -235,7 +262,10 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
             "chunked_decode_tokens_per_sec": round(user_tps, 2),  # the CLI/API fast path
             "host_sampled_tokens_per_sec": round(host_tps, 2),
             "prefill_ms_64_tokens_cold": round(prefill_ms, 1),  # includes XLA compile
-            "prefill_ms_64_tokens_warm": round(prefill_warm_ms, 1),
+            "prefill_ms_64_tokens_warm": round(prefill_warm_ms, 1),  # 1 dispatch + 1 tunnel RT
+            "prefill_ms_64_tokens_device": round(prefill_device_ms, 1),  # on-device, RT subtracted
+            "prefill_tokens_per_sec": round(prefill_tps, 1),
+            "tunnel_round_trip_ms": round(rt_ms, 1),
             "baseline": "Llama 2 7B 101.81 ms/token, 1x GCP c3d-highcpu-30 (reference README.md:131)",
             "device": None,
         },
@@ -248,8 +278,11 @@ def main():
     import jax
 
     device = jax.devices()[0]
-    seq_len = 768  # position budget: 4x64 prefill + 128-wide decode window +
-    # 128-wide chunk window (both replayed per rep) + 17 stepwise = 529
+    seq_len = 1024  # position budget: 4x64 prefill + 128-wide decode window +
+    # 128-wide chunk window (both replayed per rep) + 17 stepwise = 529.
+    # Must be a multiple of 512 (llama.ATT_CHUNK) so the bench runs the
+    # production blocked-attention decode path (768 would silently fall
+    # back to the full-S einsum)
     # PRIMARY metric: Q40 — the reference's own headline weight format, so
     # vs_baseline is an apples-to-apples Q40-vs-Q40 comparison (round-2
     # verdict: the format comparison must be the primary number, not a
@@ -295,7 +328,7 @@ def main_single(weights: str):
 
     result = None
     try:
-        result = run(llama2_7b_config(768), "llama2_7b", weights=weights)
+        result = run(llama2_7b_config(1024), "llama2_7b", weights=weights)
     except Exception as e:  # bf16 7B (~13.5 GB) may not fit where q40 does
         sys.stderr.write(
             f"7B {weights} bench failed ({type(e).__name__}: {e}); "
@@ -303,7 +336,7 @@ def main_single(weights: str):
         )
     if result is None:
         gc.collect()
-        result = run(tinyllama_config(768), "tinyllama_1_1b", weights=weights)
+        result = run(tinyllama_config(1024), "tinyllama_1_1b", weights=weights)
     print(json.dumps(result))
 
 

@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
         "2-D tp x sp mesh)",
     )
     p.add_argument(
+        "--ep", type=int, default=1,
+        help="expert-parallel shards (MoE models): each shard owns "
+        "n_experts/ep whole experts; prefill routes tokens with all_to_all "
+        "dispatch/combine, decode runs local experts + psum (composes with "
+        "--tp on a 2-D tp x ep mesh)",
+    )
+    p.add_argument(
         "--dtype",
         choices=["bf16", "f32", "q40"],
         default="bf16",
@@ -116,7 +123,8 @@ def make_engine(args):
     }[getattr(args, "cache_dtype", "auto")]
     engine = InferenceEngine(
         args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp,
-        sp=getattr(args, "sp", 1), cache_dtype=cache_dtype,
+        sp=getattr(args, "sp", 1), ep=getattr(args, "ep", 1),
+        cache_dtype=cache_dtype,
     )
     tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
     seed = args.seed if args.seed is not None else int(time.time())

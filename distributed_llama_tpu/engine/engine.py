@@ -79,6 +79,9 @@ class EngineStream:
         # the prefill_device stats entry awaiting its compute-drain time
         # (added when generate_chunks fetches the fused first token)
         self._pending_prefill_entry: TokenStats | None = None
+        # True while this stream's un-fetched prefill_device dispatch holds
+        # the engine's pipeline depth up (released at the first-token fetch)
+        self._depth_held = False
         engine._streams.append(self)
 
     @property
@@ -92,6 +95,8 @@ class EngineStream:
     def reset(self) -> None:
         self.pos = 0
         self.stats.clear()
+        self._release_depth()  # an abandoned un-fetched prefill must not pin the depth
+        self._pending_prefill_entry = None
         # keep the engine's last transfer measurement (still valid) but
         # restart the refresh cadence with the cleared token count
         self.engine._transfer_measured_at = 0
@@ -191,9 +196,11 @@ class EngineStream:
         start = time.perf_counter()
         # the dispatches below are never fetched here: mark the engine
         # non-quiescent so the transfer probe does not queue behind them and
-        # time their compute (see _transfer_ms_per_token)
-        with engine._depth_lock:
-            engine._pipeline_depth += 1
+        # time their compute (see _transfer_ms_per_token). The depth stays
+        # RAISED until the fused first token is fetched (_fetch_fused_first)
+        # — decrementing here would reopen the probe-poisoning window for
+        # the whole prefill-to-first-fetch span.
+        self._hold_depth()
         try:
             logits = self._forward_device(tokens)
             key = jax.random.PRNGKey(seed)
@@ -207,10 +214,27 @@ class EngineStream:
             )
             self.stats.append(entry)
             self._pending_prefill_entry = entry
-        finally:
-            with engine._depth_lock:
-                engine._pipeline_depth -= 1
+        except BaseException:
+            self._release_depth()
+            raise
         return token, key
+
+    def _hold_depth(self) -> None:
+        """Raise the engine's in-flight depth on this stream's behalf until
+        :meth:`_release_depth` (re-entrant safe: a second hold releases the
+        first — only one un-fetched prefill can exist per stream)."""
+        engine = self.engine
+        with engine._depth_lock:
+            if not self._depth_held:
+                engine._pipeline_depth += 1
+                self._depth_held = True
+
+    def _release_depth(self) -> None:
+        engine = self.engine
+        with engine._depth_lock:
+            if self._depth_held:
+                engine._pipeline_depth -= 1
+                self._depth_held = False
 
     def decode_step(self, token: int) -> np.ndarray:
         """One autoregressive step; returns f32 logits [vocab]."""
@@ -381,9 +405,12 @@ class EngineStream:
         the prefill's device compute, so its elapsed time is added back onto
         the prefill's stats entry (prefill_device timed only the async
         dispatch — without this the P line would report ~dispatch overhead
-        and the prefill compute would be misattributed to the first chunk)."""
+        and the prefill compute would be misattributed to the first chunk).
+        Also releases the depth hold prefill_device took: the prefill is
+        drained now, so the probe-quiescence hazard it guarded is gone."""
         start = time.perf_counter()
         tok = int(np.asarray(first_token))
+        self._release_depth()
         drained_ms = (time.perf_counter() - start) * 1000.0
         entry = self._pending_prefill_entry
         if entry is not None:
@@ -471,6 +498,12 @@ class EngineStream:
                 break
         fed = max(consumed - 1, 0) if fused_first else consumed
         self.rollback(start_pos + fed)
+        # the stream is drained here (generator closed, last chunk fetched):
+        # the one quiescent point of the fused serving flow — refresh the
+        # transfer estimate on cadence for FUTURE entries (every stats entry
+        # of this request was computed mid-flight and used the cached value;
+        # without this hook a device-decode-only server would never measure)
+        self.engine._maybe_refresh_transfer()
         return consumed
 
     # ------------------------------------------------------------------
@@ -513,6 +546,7 @@ class InferenceEngine:
         cache_dtype=None,
         tp: int = 1,
         sp: int = 1,
+        ep: int = 1,
         **cfg_overrides,
     ):
         from distributed_llama_tpu.formats.model_file import ModelFileReader
@@ -521,6 +555,9 @@ class InferenceEngine:
         quantized = dtype == "q40"
         self.tp = tp
         self.sp = sp
+        self.ep = ep
+        if ep > 1 and sp > 1:
+            raise ValueError("--ep and --sp do not compose (pick one FFN/context strategy)")
         # the parallel backend is constructed BEFORE the weights load so the
         # q40 sharded load can place each shard's pack straight onto its
         # device via make_array_from_callback — each process reads only its
@@ -533,7 +570,17 @@ class InferenceEngine:
             # "q40" is a weights-only format; the KV cache stays bf16
             cache_dtype = jnp.bfloat16 if quantized else dtype
         self.cache_dtype = cache_dtype
-        if sp > 1:
+        if ep > 1:
+            from distributed_llama_tpu.parallel import expert_parallel as epmod
+
+            # expert parallelism (optionally composed with tensor
+            # parallelism on a 2-D (tp, ep) mesh): expert banks sharded by
+            # whole experts, all_to_all dispatch for prefill, dense-local
+            # decode (see ExpertParallelForward); same duck-typed interface
+            self._tp_engine = epmod.ExpertParallelForward(
+                self.cfg, ep, tp=tp, quantized=quantized
+            )
+        elif sp > 1:
             from distributed_llama_tpu.parallel import context_parallel as spmod
 
             # sequence parallelism (optionally composed with tensor
@@ -552,8 +599,11 @@ class InferenceEngine:
         else:
             self._tp_engine = None
         # every dtype loads per-shard under tp: each process reads only its
-        # own shards' bytes and places them straight onto its devices
-        mesh = self._tp_engine.mesh if tp > 1 else None
+        # own shards' bytes and places them straight onto its devices.
+        # ep>1 loads host-side instead: the expert banks must be re-stacked
+        # on a leading expert axis before placement (stack_expert_leaves),
+        # which direct-to-device tp placement would fight
+        mesh = self._tp_engine.mesh if (tp > 1 and ep == 1) else None
         host_params = weights_lib.load_params(
             reader, self.cfg, dtype=dtype, tp=tp, mesh=mesh
         )
@@ -695,6 +745,26 @@ class InferenceEngine:
                 self._transfer_ms = self._tp_engine.measure_transfer_ms()
                 self._transfer_measured_at = n
             return self._transfer_ms
+
+    def _maybe_refresh_transfer(self) -> None:
+        """Opportunistic cadence refresh at the end of a decode stream —
+        the device-decode serving flow otherwise computes every stats entry
+        mid-flight and would never measure. Only when the cadence is DUE
+        (the extra drain fetch costs a tunnel round trip): drain any
+        leftover speculative chunk first so the probe cannot queue behind
+        it and time its compute."""
+        if self._tp_engine is None:
+            return
+        with self._depth_lock:
+            n = sum(s.n_tokens for st in self._streams for s in st.stats)
+            due = (
+                self._transfer_ms is None
+                or n - self._transfer_measured_at >= self.TRANSFER_REFRESH_TOKENS
+            )
+            if not due or self._pipeline_depth > 0:
+                return
+        np.asarray(jnp.zeros(2) + 1)  # fence: drains the device queue
+        self._transfer_ms_per_token()  # re-checks depth under the lock
 
     def _last_dispatches(self) -> int:
         """How many device programs the most recent forward issued (the sp

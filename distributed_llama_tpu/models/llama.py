@@ -36,6 +36,19 @@ from distributed_llama_tpu.models.rope import apply_rope
 
 Params = dict[str, Any]
 
+# key-axis chunk of the blocked dense attention (ops.attention): caches whose
+# seq_len is a multiple of this use the online-softmax path with a dynamic
+# chunk bound; smaller/odd caches (tiny test models) keep the full-S einsum.
+# Measured on the real v5e (7B q40, S=2048, round 5): decode 10.0 vs 17.8
+# ms/token at pos 256 and 11.6 vs 18.2 at pos 1800 — the full-S einsum both
+# reads dead slots AND runs the masked softmax over all of S. For batched
+# prefill the fori_loop serialization loses slightly (17.1 vs 15.2 ms at
+# T=64), so T>8 keeps the einsum until S is long enough that dead-slot reads
+# dominate (ATT_BLOCK_PREFILL_S). chunk 1024 measured no better (11.5 late,
+# 10.8 early).
+ATT_CHUNK = 512
+ATT_BLOCK_PREFILL_S = 4096  # blocked attention for T>8 from this seq_len up
+
 
 def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
     """y = w * x / sqrt(mean(x^2) + eps), computed in f32
@@ -113,10 +126,13 @@ def block_tail(
     att: jax.Array,
     lp: Params,
     axis_name: str | None,
+    ep_axis: str | None = None,
 ) -> jax.Array:
     """Everything after the attention mix: wo projection (+psum under TP),
     the arch-dependent residual/norm placement, and the FFN/MoE half.
-    ``att``: [T, Hl*hd]."""
+    ``att``: [T, Hl*hd]. ``ep_axis``: expert-parallel mesh axis — expert
+    banks are sharded over it and the MoE FFN runs the dispatch/combine
+    exchange (parallel.expert_parallel)."""
     out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
     if axis_name is not None:
         # the TP all-reduce: replaces gather + merge-add on root
@@ -131,7 +147,7 @@ def block_tail(
     if cfg.is_moe:
         from distributed_llama_tpu.models import moe
 
-        x = moe.moe_block(cfg, x, lp, axis_name)
+        x = moe.moe_block(cfg, x, lp, axis_name, ep_axis=ep_axis)
     else:
         x = x + ffn(cfg, x, lp, axis_name).astype(x.dtype)
     return x
@@ -200,6 +216,23 @@ def attention(
     cdt = kvc.compute_dtype(keys)
     prec = kvc.einsum_precision(keys)
     qg = q.reshape(T, Kl, kv_mul, hd).astype(cdt)
+    if (
+        S % ATT_CHUNK == 0
+        and S > ATT_CHUNK
+        and (T <= 8 or S >= ATT_BLOCK_PREFILL_S)
+    ):
+        # blocked (flash-style) attention with a DYNAMIC chunk bound: no
+        # [T, S] score tensor materializes and slots beyond pos+T are never
+        # read — the full-S einsum below reads the entire allocated cache
+        # every call (S*K*hd*2 dtype-bytes per half per layer), which at
+        # long seq_len dwarfs the live context (see ATT_CHUNK note above
+        # for the measured decode/prefill split)
+        from distributed_llama_tpu.ops.attention import blocked_attention
+
+        att = blocked_attention(
+            qg.astype(jnp.float32), keys, values, pos, ATT_CHUNK
+        ).astype(jnp.float32).reshape(T, Hl * hd)
+        return att, new_cache
     scores = kvc.scores_einsum(qg, keys, prec) / jnp.sqrt(jnp.float32(hd))
     # causal mask: query t (absolute pos+t) sees cache slots 0..pos+t
     t_idx = pos + jnp.arange(T)[:, None]
@@ -236,9 +269,10 @@ def block_forward(
     pos: jax.Array,
     rope_rows: jax.Array,
     axis_name: str | None,
+    ep_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     att, new_cache = attention(cfg, x, lp, cache_l, pos, rope_rows, axis_name)
-    return block_tail(cfg, x, att, lp, axis_name), new_cache
+    return block_tail(cfg, x, att, lp, axis_name, ep_axis=ep_axis), new_cache
 
 
 def forward_tokens(
@@ -248,6 +282,7 @@ def forward_tokens(
     cache: jax.Array,
     pos: jax.Array,
     axis_name: str | None = None,
+    ep_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Run T tokens through the model starting at absolute position ``pos``.
 
@@ -274,7 +309,9 @@ def forward_tokens(
         cache_is_list = isinstance(cache, (list, tuple))
         new_layers = []
         for l, lp in enumerate(params["layers"]):
-            x, nc = block_forward(cfg, x, lp, cache[l], pos, rope_rows, axis_name)
+            x, nc = block_forward(
+                cfg, x, lp, cache[l], pos, rope_rows, axis_name, ep_axis=ep_axis
+            )
             new_layers.append(nc)
         new_cache = type(cache)(new_layers) if cache_is_list else jnp.stack(new_layers)
     else:
@@ -282,7 +319,9 @@ def forward_tokens(
         def body(carry, scanned):
             xc = carry
             lp, cache_l = scanned
-            xc, new_cache_l = block_forward(cfg, xc, lp, cache_l, pos, rope_rows, axis_name)
+            xc, new_cache_l = block_forward(
+                cfg, xc, lp, cache_l, pos, rope_rows, axis_name, ep_axis=ep_axis
+            )
             return xc, new_cache_l
 
         x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))

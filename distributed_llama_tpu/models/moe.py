@@ -126,10 +126,21 @@ def _moe_dense(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
     return jnp.einsum("te,ted->td", weights, down, precision=jax.lax.Precision.HIGHEST)
 
 
-def moe_ffn(cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None) -> jax.Array:
+def moe_ffn(
+    cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None,
+    ep_axis: str | None = None,
+) -> jax.Array:
     """Expert-mixed SwiGLU. ``xn``: [T, dim] (already normed); returns
-    [T, dim] (psum'd over TP shards)."""
-    if xn.shape[0] == 1:
+    [T, dim] (psum'd over TP shards). With ``ep_axis`` set the expert banks
+    in ``lp`` are SHARDED over that mesh axis (device owns E/ep whole
+    experts) and the exchange runs in parallel.expert_parallel — the psum
+    over ``axis_name`` (hidden-slice partial sums under TP) still applies on
+    top."""
+    if ep_axis is not None:
+        from distributed_llama_tpu.parallel.expert_parallel import ep_moe_ffn
+
+        out = ep_moe_ffn(cfg, xn, lp, ep_axis)
+    elif xn.shape[0] == 1:
         out = _moe_topk(cfg, xn, lp)
     else:
         out = _moe_dense(cfg, xn, lp)
@@ -138,14 +149,17 @@ def moe_ffn(cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None) -> jax.A
     return out
 
 
-def moe_block(cfg: LlamaConfig, x: jax.Array, lp, axis_name: str | None) -> jax.Array:
+def moe_block(
+    cfg: LlamaConfig, x: jax.Array, lp, axis_name: str | None,
+    ep_axis: str | None = None,
+) -> jax.Array:
     """The FFN half of a MoE block, *after* the attention residual has been
     applied by the caller. Handles the Mixtral-vs-Grok norm placement."""
     from distributed_llama_tpu.models.llama import rmsnorm
 
     if cfg.arch == ArchType.GROK1:
         xn = rmsnorm(x, lp["rms_moe"])
-        out = moe_ffn(cfg, xn, lp, axis_name)
+        out = moe_ffn(cfg, xn, lp, axis_name, ep_axis=ep_axis)
         return x + rmsnorm(out.astype(x.dtype), lp["rms_ffn2"])
     xn = rmsnorm(x, lp["rms_ffn"])
-    return x + moe_ffn(cfg, xn, lp, axis_name).astype(x.dtype)
+    return x + moe_ffn(cfg, xn, lp, axis_name, ep_axis=ep_axis).astype(x.dtype)

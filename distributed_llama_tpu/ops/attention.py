@@ -1,0 +1,102 @@
+"""Online-softmax (flash-style) attention building blocks.
+
+Shared by the dense/TP blocked attention (:func:`blocked_attention`, used by
+``models.llama.attention``) and sequence parallelism's ring/sharded
+attention (``parallel.context_parallel``). The reference computes attention
+as a per-head scalar loop over every past position
+(reference: src/llama2-tasks.cpp:54-94); here a chunk of key/value rows is
+scored at once and partials merge with the standard flash-attention
+(max, exp-sum, weighted-sum) algebra — no full [T, S] score tensor ever
+materializes, and a dynamic chunk bound skips cache slots beyond the live
+context entirely.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.ops import kv_cache as kvc
+
+
+def chunk_attention(
+    q: jax.Array,  # [Tq, K, M, hd] f32 (grouped: K kv-heads × M q-per-kv)
+    k: jax.Array,  # [Tk, K, hd] — cache dtype (NOT pre-cast to f32)
+    v: jax.Array,  # [Tk, K, hd]
+    q_positions: jax.Array,  # [Tq] global positions
+    k_positions: jax.Array,  # [Tk]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Masked scores of one (q-chunk, kv-chunk) pair → (m, l, o) partials.
+
+    m: running max [Tq, K, M]; l: exp-sum [Tq, K, M]; o: weighted V sum
+    [Tq, K, M, hd]. Entirely local — no collectives. The einsums run with
+    k/v in their storage dtype and f32 accumulation: pre-casting a bf16
+    cache slice to f32 would materialize 2x the cache bytes per layer per
+    token (the same fix as llama.attention's score/value einsums).
+    """
+    hd = q.shape[-1]
+    # compute dtype follows the cache half (bf16 for an i8 half); f32 caches
+    # (parity tests) keep true-f32 multiplies, mirroring llama.attention —
+    # otherwise TPU's default bf16 demotion makes f32 runs diverge from the
+    # dense f32 path
+    cdt = kvc.compute_dtype(k)
+    prec = kvc.einsum_precision(k)
+    scores = kvc.scores_einsum(q.astype(cdt), k, prec) / jnp.sqrt(jnp.float32(hd))
+    mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)  # [Tq, K, M]
+    # fully-masked rows (no kv visible in this chunk) produce m=-inf; guard
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = kvc.mix_einsum(p, v, cdt, prec)
+    return safe_m, l, o
+
+
+def merge_partials(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partials (standard flash-attention merge)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    return m, l, o
+
+
+def blocked_attention(
+    qg: jax.Array,  # [T, K, M, hd] f32 grouped queries
+    keys,  # cache half [S, K, hd] (array or QuantizedKV)
+    values,
+    pos: jax.Array,  # scalar: absolute position of query row 0
+    chunk: int,
+) -> jax.Array:
+    """Causal attention of T query rows over a KV cache, blocked along the
+    key axis with a DYNAMIC chunk bound: only chunks holding positions
+    <= pos+T-1 are read at all, so attention cost is O(live context), not
+    O(seq_len) — the full-S masked einsum it replaces reads (and scores)
+    every allocated slot every call. Returns [T, K, M, hd] f32.
+
+    Requires S % chunk == 0 (callers fall back to the full einsum
+    otherwise). The boundary chunk's causal edge is masked inside
+    :func:`chunk_attention` by position comparison.
+    """
+    T, K, M, hd = qg.shape
+    S = keys.shape[0]
+    q_pos = pos + jnp.arange(T)
+    n_chunks = jax.lax.div(pos + T + chunk - 1, chunk)
+
+    def body(i, carry):
+        m, l, o = carry
+        start = i * chunk
+        kc = kvc.slice_rows(keys, start, chunk)
+        vc = kvc.slice_rows(values, start, chunk)
+        k_pos = start + jnp.arange(chunk)
+        ms, ls, os_ = chunk_attention(qg, kc, vc, q_pos, k_pos)
+        return merge_partials(m, l, o, ms, ls, os_)
+
+    m0 = jnp.full((T, K, M), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((T, K, M), jnp.float32)
+    o0 = jnp.zeros((T, K, M, hd), jnp.float32)
+    m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+    return o / jnp.maximum(l, 1e-30)[..., None]

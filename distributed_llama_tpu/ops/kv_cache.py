@@ -133,6 +133,19 @@ def select_row_update(half, row: jax.Array, lpos, owner):
     )
 
 
+def slice_rows(half, start, n: int):
+    """Read ``n`` cache slots [start, start+n) (the blocked-attention chunk
+    read). ``start`` may be traced; ``n`` is static."""
+    if isinstance(half, QuantizedKV):
+        S, K, hd = half.data.shape
+        return QuantizedKV(
+            jax.lax.dynamic_slice(half.data, (start, 0, 0), (n, K, hd)),
+            jax.lax.dynamic_slice(half.scales, (start, 0, 0), (n, K, 1)),
+        )
+    S, K, hd = half.shape
+    return jax.lax.dynamic_slice(half, (start, 0, 0), (n, K, hd))
+
+
 def compute_dtype(half):
     """The einsum operand dtype for a cache half: the storage dtype for
     plain caches (bf16 reads stay bf16, f32 parity stays f32); bf16 for i8

@@ -29,52 +29,14 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llama_tpu.ops import kv_cache as kvc
+from distributed_llama_tpu.ops.attention import chunk_attention, merge_partials
 from distributed_llama_tpu.parallel.tensor_parallel import TransferProbeMixin
 
-
-def _chunk_attention(
-    q: jax.Array,  # [Tq, K, M, hd] f32 (grouped: K kv-heads × M q-per-kv)
-    k: jax.Array,  # [Tk, K, hd] — cache dtype (NOT pre-cast to f32)
-    v: jax.Array,  # [Tk, K, hd]
-    q_positions: jax.Array,  # [Tq] global positions
-    k_positions: jax.Array,  # [Tk]
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Masked scores of one (q-chunk, kv-chunk) pair → (m, l, o) partials.
-
-    m: running max [Tq, K, M]; l: exp-sum [Tq, K, M]; o: weighted V sum
-    [Tq, K, M, hd]. Entirely local — no collectives. The einsums run with
-    k/v in their storage dtype and f32 accumulation: pre-casting a bf16
-    cache slice to f32 would materialize 2x the cache bytes per layer per
-    token (the same fix as llama.attention's score/value einsums).
-    """
-    hd = q.shape[-1]
-    # compute dtype follows the cache half (bf16 for an i8 half); f32 caches
-    # (parity tests) keep true-f32 multiplies, mirroring llama.attention —
-    # otherwise TPU's default bf16 demotion makes f32 SP runs diverge from
-    # the dense f32 path
-    cdt = kvc.compute_dtype(k)
-    prec = kvc.einsum_precision(k)
-    scores = kvc.scores_einsum(q.astype(cdt), k, prec) / jnp.sqrt(jnp.float32(hd))
-    mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
-    scores = jnp.where(mask, scores, -jnp.inf)
-    m = jnp.max(scores, axis=-1)  # [Tq, K, M]
-    # fully-masked rows (no kv visible in this chunk) produce m=-inf; guard
-    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(scores - safe_m[..., None])
-    p = jnp.where(mask, p, 0.0)
-    l = jnp.sum(p, axis=-1)
-    o = kvc.mix_einsum(p, v, cdt, prec)
-    return safe_m, l, o
-
-
-def _merge(m1, l1, o1, m2, l2, o2):
-    """Merge two online-softmax partials (standard flash-attention merge)."""
-    m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
-    l = l1 * a1 + l2 * a2
-    o = o1 * a1[..., None] + o2 * a2[..., None]
-    return m, l, o
+# the online-softmax primitives live in ops.attention (shared with the dense
+# blocked-attention path); keep the historical local names — they are part
+# of this module's documented surface
+_chunk_attention = chunk_attention
+_merge = merge_partials
 
 
 def ring_attention(
