@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--tp on a 2-D tp x ep mesh)",
     )
     p.add_argument(
+        "--moe-capacity", type=float, default=0.0,
+        help="MoE prefill capacity factor: per-expert buckets hold "
+        "ceil(F*T*k/E) rows, overflow DROPS (lossy, standard capacity "
+        "semantics; ~15%% faster Mixtral prefill at 2.0). 0 = exact "
+        "(default): worst-case drop-free buckets",
+    )
+    p.add_argument(
         "--dtype",
         choices=["bf16", "f32", "q40"],
         default="bf16",
@@ -125,6 +132,7 @@ def make_engine(args):
         args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp,
         sp=getattr(args, "sp", 1), ep=getattr(args, "ep", 1),
         cache_dtype=cache_dtype,
+        moe_capacity_factor=getattr(args, "moe_capacity", 0.0) or 0.0,
     )
     tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
     seed = args.seed if args.seed is not None else int(time.time())

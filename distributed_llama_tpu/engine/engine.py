@@ -120,13 +120,11 @@ class EngineStream:
             raise ValueError("empty token batch: at least one token required")
         if self.pos + n > engine.cfg.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {n} > {engine.cfg.seq_len}")
-        if n == 1 or (
-            # backends that chunk mid-context prompts themselves (sp) pad to
-            # their own fixed chunk width — engine bucket-padding on top
+        if n == 1 or getattr(engine._tp_engine, "prefers_exact_mid_prefill", False):
+            # backends that pad/chunk multi-token prompts themselves (sp:
+            # fixed-width masked-scatter chunks at any position, seq_len
+            # padding on the ring path) — engine bucket-padding on top
             # would only inflate the dispatch count
-            self.pos > 0
-            and getattr(engine._tp_engine, "prefers_exact_mid_prefill", False)
-        ):
             padded = tokens
         else:
             bucket = _prefill_bucket(n)
@@ -143,6 +141,10 @@ class EngineStream:
     def forward(self, tokens: list[int] | np.ndarray) -> np.ndarray:
         """Run tokens at the current position; returns f32 logits [T, vocab]
         (padded positions stripped). Advances pos by len(tokens)."""
+        # an abandoned fused prefill (prefill_device whose token was never
+        # fetched) must not pin the engine depth: this call's own fetch
+        # drains the device queue anyway
+        self._release_depth()
         tokens = np.asarray(tokens, dtype=np.int32)
         n = tokens.shape[0]
         start = time.perf_counter()
@@ -162,6 +164,7 @@ class EngineStream:
         64-token prefill of a 32k-vocab model would otherwise ship 8 MB of
         f32 logits per prompt (measured ~2 s through a remote PJRT tunnel
         vs ~tens of ms for the row)."""
+        self._release_depth()  # see forward()
         tokens = np.asarray(tokens, dtype=np.int32)
         n = tokens.shape[0]
         start = time.perf_counter()
@@ -221,8 +224,10 @@ class EngineStream:
 
     def _hold_depth(self) -> None:
         """Raise the engine's in-flight depth on this stream's behalf until
-        :meth:`_release_depth` (re-entrant safe: a second hold releases the
-        first — only one un-fetched prefill can exist per stream)."""
+        :meth:`_release_depth`. Idempotent: a second hold while the first is
+        outstanding is absorbed (at most one un-fetched prefill can exist
+        per stream, and the hold is released at its first-token fetch, a
+        reset(), or the start of any fetching forward/prefill)."""
         engine = self.engine
         with engine._depth_lock:
             if not self._depth_held:

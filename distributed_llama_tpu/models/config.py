@@ -38,6 +38,13 @@ class LlamaConfig:
     # the correct frequency scaling matches HF and gives the intended
     # long-context behavior.
     rope_llama3_reference_quirk: bool = False
+    # MoE prefill/dispatch capacity factor: per-expert bucket size is
+    # ceil(factor * tokens * k / E) rows, overflow rows DROP (standard
+    # capacity semantics — faster, but lossy under routing imbalance).
+    # 0.0 (default) = exact: drop-free buckets sized for the worst case
+    # (the parity-with-the-reference default); opt into e.g. 2.0 via the
+    # CLI/server --moe-capacity flag for the measured prefill speedup.
+    moe_capacity_factor: float = 0.0
 
     @property
     def kv_mul(self) -> int:

@@ -16,11 +16,14 @@ Two compute paths, chosen per batch shape inside one jitted program family:
   SORT-COMPACTED per-expert capacity buckets: each shard takes its T/ep
   token slice, ranks every (token, choice) pair within its target expert
   (a cumsum over the one-hot expert assignment), scatters rows into a
-  ``[E, Ce, D]`` send buffer (``Ce = ceil(capacity_factor·Tl·k/E)``; rows
-  ranked past Ce drop, the standard capacity-drop semantics), and two
-  ``all_to_all``s move rows to expert owners and outputs back. Each local
-  expert computes ONE dense [ep·Ce, D] matmul — no masking in the hot
-  compute, no Tl·k sparse slots (the round-4 prototype's layout).
+  ``[E, Ce, D]`` send buffer, and two ``all_to_all``s move rows to expert
+  owners and outputs back. Each local expert computes ONE dense
+  [ep·Ce, D] matmul — no masking in the hot compute, no Tl·k sparse slots
+  (the round-4 prototype's layout). ``Ce`` follows
+  ``cfg.moe_capacity_factor``: 0 (default) sizes buckets for the drop-free
+  worst case (EXACT outputs); >0 uses the standard lossy capacity
+  semantics (``ceil(factor·Tl·k/E)``, overflow drops) — opt-in via
+  ``--moe-capacity``.
 * **Dense-local (decode / tiny batches)** — every shard runs its El local
   experts on the (replicated) tokens, weights them with its slice of the
   router matrix, and a psum over ``ep`` combines. For T=1 this costs El
@@ -54,12 +57,6 @@ import numpy as np
 
 from distributed_llama_tpu.models.config import LlamaConfig
 from distributed_llama_tpu.parallel.tensor_parallel import TransferProbeMixin
-
-# per-expert capacity = ceil(capacity_factor * Tl * k / E) rows per source
-# shard: 1.0 = perfectly balanced routing fits exactly; 2.0 (default)
-# absorbs typical imbalance. Tests that need drop-free routing raise it.
-EP_CAPACITY_FACTOR = 2.0
-
 
 def local_expert_weights(lp, e: int):
     """Weights of LOCAL expert ``e`` from EP layer params: stacked q40
@@ -125,8 +122,18 @@ def _ep_dense_local(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
 
 def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
     """Prefill path: sort-compacted capacity buckets + two all_to_alls
-    (dispatch/combine) + one all_gather (token re-replication)."""
-    from distributed_llama_tpu.models.moe import _expert_ffn, router_probs
+    (dispatch/combine) + one all_gather (token re-replication). Bucket
+    algebra shared with the dense bucketed prefill (models.moe). Capacity
+    follows cfg.moe_capacity_factor: 0 (default) = drop-free worst-case
+    buckets (exact), >0 = standard capacity-drop semantics."""
+    from distributed_llama_tpu.models.moe import (
+        _expert_ffn,
+        bucket_capacity,
+        bucket_combine,
+        bucket_rank,
+        bucket_scatter,
+        router_probs,
+    )
 
     T, D = xn.shape
     E = cfg.n_experts
@@ -134,32 +141,15 @@ def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
     k = cfg.n_active_experts
     Tl = T // ep
     idx = jax.lax.axis_index(ep_axis)
-    # per-(shard, expert) capacity, rounded UP to a multiple of 4; never
-    # larger than the drop-free bound Tl*k
-    import math
-
-    Ce = min(max(4, -(-math.ceil(EP_CAPACITY_FACTOR * Tl * k / E) // 4) * 4), Tl * k)
+    Ce = bucket_capacity(cfg.moe_capacity_factor, Tl, k, E)
 
     x_local = jax.lax.dynamic_slice(xn, (idx * Tl, 0), (Tl, D))
     probs = router_probs(cfg, x_local, lp["router"])  # [Tl, E]
     top_vals, top_idx = jax.lax.top_k(probs, k)  # [Tl, k]
     top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
 
-    # rank every (token, choice) within its target expert: cumsum over the
-    # one-hot assignment in flat (t, j) order — the "sort" of the compacted
-    # buckets without an actual sort
-    N = Tl * k
-    flat_e = top_idx.reshape(N)
-    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)  # [N, E]
-    rank = (jnp.cumsum(onehot, axis=0) - onehot)[jnp.arange(N), flat_e]  # [N]
-
-    # scatter rows into per-expert buckets; rank >= Ce lands in a spill row
-    # that is trimmed (capacity drop)
-    slot = jnp.where(rank < Ce, rank, Ce)
-    t_ids = jnp.repeat(jnp.arange(Tl), k)
-    send = (
-        jnp.zeros((E, Ce + 1, D), xn.dtype).at[flat_e, slot].set(x_local[t_ids])
-    )[:, :Ce]
+    flat_e, rank, t_ids = bucket_rank(top_idx, E)
+    send = bucket_scatter(x_local, flat_e, rank, t_ids, E, Ce)
 
     # all_to_all #1: rows travel to their expert's owner shard.
     # send viewed as [ep owners, El, Ce, D]; recv[s] = what shard s sent
@@ -184,11 +174,7 @@ def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
     back = back.reshape(E, Ce, D)
 
     # combine on the home shard: dropped choices contribute zero
-    valid = (rank < Ce).reshape(Tl, k)
-    gathered = back[top_idx, jnp.minimum(rank.reshape(Tl, k), Ce - 1)]  # [Tl, k, D]
-    out_local = jnp.einsum(
-        "tk,tkd->td", top_vals * valid.astype(jnp.float32), gathered
-    )  # [Tl, D] f32
+    out_local = bucket_combine(back, top_idx, rank, top_vals, Ce)  # [Tl, D] f32
 
     # re-replicate the token axis for the (replicated) rest of the network
     return jax.lax.all_gather(out_local, ep_axis, axis=0, tiled=True)  # [T, D]

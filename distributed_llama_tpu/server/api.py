@@ -211,12 +211,17 @@ class ApiState:
 
         max_pos = prompt_end + params["max_tokens"] if params["max_tokens"] > 0 else seq_len
         max_pos = min(max_pos, seq_len)
+        # completion budget in emitted tokens (OpenAI max_tokens semantics);
+        # zero budget (prompt fills the remaining context) emits nothing —
+        # and must NOT take the fused path, whose depth hold is only
+        # released at the first-token fetch that would never happen
+        max_new = max_pos - prompt_end
 
         slot.sampler.set_temperature(params["temperature"])
         if params["seed"] is not None:
             slot.sampler.set_seed(params["seed"])
 
-        device_decode = getattr(self.args, "decode", "device") == "device"
+        device_decode = getattr(self.args, "decode", "device") == "device" and max_new > 0
         seed = params["seed"]
         if seed is None:
             seed = int(time.time_ns() % (1 << 31))
@@ -257,9 +262,6 @@ class ApiState:
                 detector.clear()
             return res
 
-        # completion budget in emitted tokens (OpenAI max_tokens semantics);
-        # zero budget (prompt fills the remaining context) emits nothing
-        max_new = max_pos - prompt_end
         res = EosDetectorResult.NOT_EOS
         if device_decode:
             if max_new == 1:

@@ -223,6 +223,23 @@ class TestApiHardening:
             assert r["object"] == "chat.completion"
             assert r["usage"]["completion_tokens"] <= 4
 
+    def test_zero_budget_prompt_emits_nothing_and_leaks_no_depth(self, served):
+        """A prompt that fills the remaining context (max_new == 0) must
+        return a clean empty completion with a truncation warning — and must
+        NOT take the fused prefill path, whose depth hold is only released
+        at a first-token fetch that never happens (a leak would freeze the
+        engine's transfer-probe machinery for the rest of the process)."""
+        url, state = served
+        for slot in state.slots:
+            slot.stream.reset()
+            slot.cache.clear()
+        with post(url, {"messages": [{"role": "user", "content": "ab " * 400}],
+                        "max_tokens": 4}) as r:
+            data = json.loads(r.read())
+        assert data["usage"]["completion_tokens"] == 0
+        assert "warning" in data
+        assert state.engine._pipeline_depth == 0
+
     def test_two_concurrent_streams_interleave(self, served):
         """Two SSE completions must be in flight AT THE SAME TIME, each on
         its own engine stream — the capability the reference cannot have

@@ -10,28 +10,26 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-import distributed_llama_tpu.parallel.expert_parallel as epmod
 from distributed_llama_tpu.models.config import config_from_spec
 from distributed_llama_tpu.parallel.expert_parallel import ExpertParallelMoE
 from tests.model_utils import random_tensors, tiny_spec, write_model_file
 
 
 @pytest.fixture
-def drop_free(monkeypatch):
-    """Parity tests need routing without capacity drops: random routers can
-    send most tokens to one expert, which the default factor legitimately
-    drops."""
-    monkeypatch.setattr(epmod, "EP_CAPACITY_FACTOR", 1e9)
+def drop_free():
+    """The engine default IS drop-free (moe_capacity_factor=0 sizes buckets
+    for the worst case); kept as an explicit marker on parity tests."""
+    yield
 
 
-def _moe_setup(E=4, k=2, T=8, D=32, H=64, seed=0):
+def _moe_setup(E=4, k=2, T=8, D=32, H=64, seed=0, capacity=0.0):
     from distributed_llama_tpu.formats.model_file import ArchType
 
     spec = tiny_spec(
         arch_type=ArchType.MIXTRAL, dim=D, hidden_dim=H, n_experts=E,
         n_active_experts=k, vocab_size=64, seq_len=32,
     )
-    cfg = config_from_spec(spec)
+    cfg = config_from_spec(spec, moe_capacity_factor=capacity)
     rng = np.random.RandomState(seed)
     xn = rng.randn(T, D).astype(np.float32)
     router = rng.randn(D, E).astype(np.float32) / np.sqrt(D)
@@ -88,12 +86,12 @@ class TestExpertParallel:
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
     def test_capacity_drop_is_bounded_and_finite(self):
-        """With the default capacity factor, overloaded experts drop their
+        """With an opted-in capacity factor, overloaded experts drop their
         overflow: the output must stay finite and equal the dense reference
         on every token whose choices all fit (here: compare only the
         overall error bound — dropped rows zero their contribution, so the
         EP output is a damped version of the dense one, never NaN/inf)."""
-        cfg, xn, router, gate, up, down = _moe_setup(E=4, k=2, T=16, seed=7)
+        cfg, xn, router, gate, up, down = _moe_setup(E=4, k=2, T=16, seed=7, capacity=1.0)
         epm = ExpertParallelMoE(cfg, 4)
         got = np.asarray(epm(xn, router, gate, up, down))
         assert np.all(np.isfinite(got))

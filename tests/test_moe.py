@@ -129,6 +129,37 @@ class TestQ40Moe:
         batch = q40b.forward(tokens)
         np.testing.assert_allclose(batch, step, rtol=2e-3, atol=2e-3)
 
+    def test_q40_bucketed_prefill_matches_serial(self, tmp_path):
+        """The capacity-bucketed prefill (one fused FFN per expert over its
+        gathered rows, --moe-capacity) must reproduce the default serial
+        all-E path exactly when no rows drop: same kernels, same rows, only
+        the gather differs. A huge factor clamps to drop-free buckets."""
+        spec = self._spec(seq_len=96)
+        tensors = random_tensors(spec, seed=4)
+        path = str(tmp_path / "moe_q40_b.m")
+        write_model_file(path, spec, tensors)
+        tokens = list(np.random.RandomState(0).randint(1, spec.vocab_size, 48))
+
+        bucketed = InferenceEngine(
+            path, dtype="q40", moe_capacity_factor=1e9
+        ).forward(tokens)
+        serial = InferenceEngine(path, dtype="q40").forward(tokens)  # default: exact
+        np.testing.assert_allclose(bucketed, serial, rtol=2e-3, atol=2e-3)
+
+    def test_q40_bucketed_prefill_drops_are_bounded(self, tmp_path):
+        """With an opted-in lossy capacity factor, overloaded experts drop
+        rows: output must stay finite (drops only remove a renormalized
+        sub-term)."""
+        spec = self._spec(seq_len=96)
+        tensors = random_tensors(spec, seed=5)
+        path = str(tmp_path / "moe_q40_c.m")
+        write_model_file(path, spec, tensors)
+        tokens = list(np.random.RandomState(1).randint(1, spec.vocab_size, 48))
+        out = InferenceEngine(
+            path, dtype="q40", moe_capacity_factor=1.0
+        ).forward(tokens)
+        assert np.all(np.isfinite(out))
+
     def test_q40_moe_tp_greedy_stream(self, tmp_path):
         """Q40 MoE under TP: per-expert sharded packs (gate|up out-sharded,
         down in-sharded) reproduce the single-device greedy stream."""
