@@ -66,7 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="MoE prefill capacity factor: per-expert buckets hold "
         "ceil(F*T*k/E) rows, overflow DROPS (lossy, standard capacity "
         "semantics; ~15%% faster Mixtral prefill at 2.0). 0 = exact "
-        "(default): worst-case drop-free buckets",
+        "(default): worst-case drop-free buckets. Applies to the q40 "
+        "per-expert layout (prompts >= 32 tokens) and the --ep dispatch; "
+        "the bf16 stacked-bank prefill ignores it (already one batched "
+        "einsum)",
     )
     p.add_argument(
         "--dtype",

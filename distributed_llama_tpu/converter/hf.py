@@ -1,9 +1,18 @@
-"""HF safetensors checkpoint → `.m` (llama / mistral / mixtral).
+"""HF safetensors checkpoint → `.m` (llama / mistral / mixtral / grok-1).
 
 Parity with reference converter/convert-hf.py: the tensor plan order matches
 the C++ loader (convert-hf.py:52-90), Q/K projections are permuted from the
 HF neox pair layout to the interleaved rope layout (:12-15), and the header
 carries rope scaling when config.json has it (:190-196).
+
+Beyond the reference: Grok-1 (``model_type: "grok-1"`` — the hpcai-tech/
+grok-1 transformers port's naming: attn.*_proj, moe_block.gate,
+moe_block.experts.{e}.{linear,linear_v,linear_1}, pre/post attn/moe norms).
+Grok keeps the neox Q/K layout (no permute): the runtime's GROK1 arch
+defaults to falcon/neox rope like the reference's FalconRopeCommand. The
+original checkpoint's attn_output_multiplier/embedding/output scale
+constants are hardcoded in the runtime (models/llama.py, matching
+src/grok1-tasks.cpp:11-14, 270-273), so they are not read from config.
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ ARCH_BY_MODEL_TYPE = {
     "llama": ArchType.LLAMA,
     "mistral": ArchType.LLAMA,
     "mixtral": ArchType.MIXTRAL,
+    "grok-1": ArchType.GROK1,
 }
 
 HIDDEN_ACT = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}
@@ -46,10 +56,16 @@ def spec_from_hf_config(config: dict, float_type: FloatType) -> ModelSpec:
     arch = ARCH_BY_MODEL_TYPE.get(config["model_type"])
     if arch is None:
         raise ValueError(f"unsupported model type: {config['model_type']}")
-    n_experts = int(config.get("num_local_experts") or 0)
+    n_experts = int(
+        config.get("num_local_experts") or config.get("num_experts") or 0
+    )
     n_active = int(
         config.get("num_active_local_experts") or config.get("num_experts_per_tok") or 0
     )
+    # grok-1 configs may omit hidden_act (its experts are always gelu)
+    act = config.get("hidden_act") or ("gelu" if arch == ArchType.GROK1 else None)
+    if act is None:
+        raise ValueError("config.json is missing hidden_act")
     spec = ModelSpec(
         arch_type=arch,
         dim=config["hidden_size"],
@@ -61,10 +77,14 @@ def spec_from_hf_config(config: dict, float_type: FloatType) -> ModelSpec:
         seq_len=config["max_position_embeddings"],
         n_experts=n_experts,
         n_active_experts=n_active,
-        hidden_act=HIDDEN_ACT[config["hidden_act"]],
+        hidden_act=HIDDEN_ACT[act],
         rope_theta=float(config.get("rope_theta") or 10000.0),
         weights_float_type=float_type,
     )
+    if arch == ArchType.GROK1:
+        # no Q/K permute for grok (see module docstring): leave the header
+        # rope unset so both runtimes resolve their falcon/neox default
+        return spec
     # The converter permutes Q/K into the interleaved-pair layout, so the
     # correct rope for every converted HF model is LLAMA (interleaved). The
     # reference converter leaves the header rope type unset, which makes the
@@ -127,8 +147,44 @@ class _LazySafetensors:
         return np.asarray(self._open.get_tensor(name))
 
 
+def grok1_tensor_plan(spec: ModelSpec) -> list[tuple[str, str, bool]]:
+    """[(m_name, hf_name, permute)] for the hpcai-tech/grok-1 transformers
+    port: attn.* projections (no permute — neox rope), moe_block router +
+    linear (w1/gate) / linear_v (w3/up) / linear_1 (w2/down) experts, and
+    grok's four per-layer norms mapped to rms_att / rms_ffn (post-attn) /
+    rms_moe (pre-moe) / rms_ffn2 (post-moe)."""
+    plan: list[tuple[str, str, bool]] = [("embedding", "model.embed_tokens.weight", False)]
+    for l in range(spec.n_layers):
+        hp = f"model.layers.{l}."
+        mp = f"layers.{l}."
+        plan += [
+            (mp + "q", hp + "attn.q_proj.weight", False),
+            (mp + "k", hp + "attn.k_proj.weight", False),
+            (mp + "v", hp + "attn.v_proj.weight", False),
+            (mp + "wo", hp + "attn.o_proj.weight", False),
+            (mp + "moe_router", hp + "moe_block.gate.weight", False),
+        ]
+        for e in range(spec.n_experts):
+            ep = hp + f"moe_block.experts.{e}."
+            plan += [
+                (mp + f"experts.{e}.up", ep + "linear_v.weight", False),
+                (mp + f"experts.{e}.gate", ep + "linear.weight", False),
+                (mp + f"experts.{e}.down", ep + "linear_1.weight", False),
+            ]
+        plan += [
+            (mp + "rms_att", hp + "pre_attn_norm.weight", False),
+            (mp + "rms_ffn", hp + "post_attn_norm.weight", False),
+            (mp + "rms_moe", hp + "pre_moe_norm.weight", False),
+            (mp + "rms_ffn2", hp + "post_moe_norm.weight", False),
+        ]
+    plan += [("rms_final", "model.norm.weight", False), ("wcls", "lm_head.weight", False)]
+    return plan
+
+
 def hf_tensor_plan(spec: ModelSpec) -> list[tuple[str, str, bool]]:
     """[(m_name, hf_name, permute)] in `.m` layout order."""
+    if spec.arch_type == ArchType.GROK1:
+        return grok1_tensor_plan(spec)
     plan: list[tuple[str, str, bool]] = [("embedding", "model.embed_tokens.weight", False)]
     for l in range(spec.n_layers):
         hp = f"model.layers.{l}."

@@ -44,12 +44,21 @@ def router_probs(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Arra
     return jax.nn.softmax(logits, axis=-1)
 
 
-def router_weights(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Array:
-    """[T, E] mixing weights: top-k selected, renormalized to sum to 1,
-    zero elsewhere (reference: src/grok1-tasks.cpp:62-114)."""
+def router_topk(
+    cfg: LlamaConfig, xn: jax.Array, router: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k routing: ([T, k] renormalized weights, [T, k] expert ids) —
+    the single home of the select-then-renormalize convention
+    (reference: src/grok1-tasks.cpp:62-114)."""
     probs = router_probs(cfg, xn, router)
     top_vals, top_idx = jax.lax.top_k(probs, cfg.n_active_experts)
-    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    return top_vals / jnp.sum(top_vals, axis=-1, keepdims=True), top_idx
+
+
+def router_weights(cfg: LlamaConfig, xn: jax.Array, router: jax.Array) -> jax.Array:
+    """[T, E] mixing weights: top-k selected, renormalized to sum to 1,
+    zero elsewhere."""
+    top_vals, top_idx = router_topk(cfg, xn, router)
     one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32)  # [T, k, E]
     return jnp.einsum("tk,tke->te", top_vals, one_hot)
 
@@ -82,9 +91,8 @@ def _moe_topk(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
     """Decode path: run exactly the k selected experts via lax.switch.
     Routing is replicated across shards (same input -> same indexes), the
     reference's index broadcast with the broadcast removed."""
-    probs = router_probs(cfg, xn, lp["router"])  # [1, E]
-    top_vals, top_idx = jax.lax.top_k(probs[0], cfg.n_active_experts)
-    top_vals = top_vals / jnp.sum(top_vals)
+    top_vals, top_idx = router_topk(cfg, xn, lp["router"])  # [1, k]
+    top_vals, top_idx = top_vals[0], top_idx[0]
     branches = [
         (lambda x_, e=e: _expert_ffn(cfg, x_, _expert_weights(lp, e)))
         for e in range(cfg.n_experts)
@@ -208,9 +216,7 @@ def _moe_dense_bucketed(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
     T, D = xn.shape
     E = cfg.n_experts
     k = cfg.n_active_experts
-    probs = router_probs(cfg, xn, lp["router"])  # [T, E]
-    top_vals, top_idx = jax.lax.top_k(probs, k)
-    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    top_vals, top_idx = router_topk(cfg, xn, lp["router"])  # [T, k]
 
     C = bucket_capacity(cfg.moe_capacity_factor, T, k, E)
     flat_e, rank, t_ids = bucket_rank(top_idx, E)

@@ -132,7 +132,7 @@ def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
         bucket_combine,
         bucket_rank,
         bucket_scatter,
-        router_probs,
+        router_topk,
     )
 
     T, D = xn.shape
@@ -144,9 +144,7 @@ def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
     Ce = bucket_capacity(cfg.moe_capacity_factor, Tl, k, E)
 
     x_local = jax.lax.dynamic_slice(xn, (idx * Tl, 0), (Tl, D))
-    probs = router_probs(cfg, x_local, lp["router"])  # [Tl, E]
-    top_vals, top_idx = jax.lax.top_k(probs, k)  # [Tl, k]
-    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    top_vals, top_idx = router_topk(cfg, x_local, lp["router"])  # [Tl, k]
 
     flat_e, rank, t_ids = bucket_rank(top_idx, E)
     send = bucket_scatter(x_local, flat_e, rank, t_ids, E, Ce)

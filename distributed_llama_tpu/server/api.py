@@ -263,7 +263,7 @@ class ApiState:
             return res
 
         res = EosDetectorResult.NOT_EOS
-        if device_decode:
+        if device_decode:  # implies max_new > 0 (see device_decode above)
             if max_new == 1:
                 # 1-token completion: fetch the fused token directly — a
                 # decode stream would dispatch a whole speculative chunk
@@ -272,7 +272,7 @@ class ApiState:
                 res = feed(prompt_tokens[-1], token)
                 if res == EosDetectorResult.EOS:
                     finish_reason = "stop"
-            elif max_new > 0:
+            else:
                 # fast path: chunked on-device decode+sampling (temperature
                 # and top-p are runtime values — no per-request recompile);
                 # the fused first token arrives with the stream

@@ -104,6 +104,86 @@ class TestHfMixtralParity:
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
 
+class TestHfGrok1Converter:
+    """Grok-1 conversion (beyond the reference, which has no Grok-1 input
+    path at all): a synthetic checkpoint in the hpcai-tech/grok-1
+    transformers-port naming converts to a `.m` whose logits equal a
+    directly-written model file with the same weights — validating the
+    name mapping, the four-norm placement, and the no-permute (neox rope)
+    contract."""
+
+    def _fake_grok_checkpoint(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        from tests.model_utils import random_tensors, tiny_spec, write_model_file
+        from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct
+
+        spec = tiny_spec(
+            arch_type=ArchType.GROK1, n_experts=4, n_active_experts=2,
+            hidden_act=HiddenAct.GELU, dim=64, hidden_dim=128, n_heads=4,
+            n_kv_heads=2, vocab_size=96, seq_len=48,
+        )
+        tensors = random_tensors(spec, seed=6)  # keyed by .m names
+        direct = str(tmp_path / "direct.m")
+        write_model_file(direct, spec, tensors)
+
+        # mirror the same weights under the HF-port names
+        hf = {"model.embed_tokens.weight": tensors["embedding"]}
+        for l in range(spec.n_layers):
+            mp, hp = f"layers.{l}.", f"model.layers.{l}."
+            hf[hp + "attn.q_proj.weight"] = tensors[mp + "q"]
+            hf[hp + "attn.k_proj.weight"] = tensors[mp + "k"]
+            hf[hp + "attn.v_proj.weight"] = tensors[mp + "v"]
+            hf[hp + "attn.o_proj.weight"] = tensors[mp + "wo"]
+            hf[hp + "moe_block.gate.weight"] = tensors[mp + "moe_router"]
+            for e in range(spec.n_experts):
+                ep = f"{hp}moe_block.experts.{e}."
+                hf[ep + "linear.weight"] = tensors[f"{mp}experts.{e}.gate"]
+                hf[ep + "linear_v.weight"] = tensors[f"{mp}experts.{e}.up"]
+                hf[ep + "linear_1.weight"] = tensors[f"{mp}experts.{e}.down"]
+            hf[hp + "pre_attn_norm.weight"] = tensors[mp + "rms_att"]
+            hf[hp + "post_attn_norm.weight"] = tensors[mp + "rms_ffn"]
+            hf[hp + "pre_moe_norm.weight"] = tensors[mp + "rms_moe"]
+            hf[hp + "post_moe_norm.weight"] = tensors[mp + "rms_ffn2"]
+        hf["model.norm.weight"] = tensors["rms_final"]
+        hf["lm_head.weight"] = tensors["wcls"]
+
+        src = tmp_path / "hf_grok"
+        src.mkdir()
+        save_file({k: v.astype(np.float32) for k, v in hf.items()},
+                  str(src / "model.safetensors"))
+        config = dict(
+            model_type="grok-1",
+            hidden_size=spec.dim,
+            intermediate_size=spec.hidden_dim,
+            num_hidden_layers=spec.n_layers,
+            num_attention_heads=spec.n_heads,
+            num_key_value_heads=spec.n_kv_heads,
+            vocab_size=spec.vocab_size,
+            max_position_embeddings=spec.seq_len,
+            num_experts=spec.n_experts,
+            num_experts_per_tok=spec.n_active_experts,
+        )
+        (src / "config.json").write_text(json.dumps(config))
+        return str(src), direct
+
+    def test_grok1_conversion_matches_direct_write(self, tmp_path):
+        from distributed_llama_tpu.formats.model_file import ArchType, RopeType
+
+        src, direct = self._fake_grok_checkpoint(tmp_path)
+        out = str(tmp_path / "grok.m")
+        spec = convert_hf(src, FloatType.F32, out, progress=lambda *a: None)
+        assert spec.arch_type == ArchType.GROK1
+        assert spec.n_experts == 4 and spec.n_active_experts == 2
+        # no permute -> header rope stays unset, resolving to falcon/neox
+        assert spec.resolved_rope_type() == RopeType.FALCON
+
+        tokens = [1, 17, 42, 5, 9]
+        got = InferenceEngine(out, dtype=jnp.float32).forward(tokens)
+        want = InferenceEngine(direct, dtype=jnp.float32).forward(tokens)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
 class TestHfTokenizerConverter:
     def test_bpe_tokenizer_json(self, tmp_path):
         vocab = {"<unk>": 0, "a": 1, "b": 2, "ab": 3, " ": 4}

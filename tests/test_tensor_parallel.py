@@ -218,3 +218,28 @@ class TestTransferProbeDce:
         for _ in range(3):
             e.generate_on_device(5, 4, temperature=0.0)
         assert len(calls) >= 3  # re-measured as the token count crossed 4, 8, ...
+
+    def test_engine_measures_transfer_under_fused_device_decode(self, tmp_path):
+        """The fused serving flow (prefill_device -> stream_decode) computes
+        every stats entry while a dispatch is in flight; the measurement
+        must still happen — at the end-of-stream quiescent point — instead
+        of silently reporting transfer=0 forever (round-5 review finding)."""
+        from distributed_llama_tpu.engine import InferenceEngine
+        from tests.model_utils import random_tensors, tiny_spec, write_model_file
+
+        spec = tiny_spec(dim=64, n_heads=4, n_kv_heads=4, hidden_dim=128,
+                         vocab_size=64, seq_len=64)
+        path = str(tmp_path / "fused.m")
+        write_model_file(path, spec, random_tensors(spec, seed=2))
+        e = InferenceEngine(path, dtype=jnp.float32, tp=2)
+        calls = []
+        orig = e._tp_engine.measure_transfer_ms
+        e._tp_engine.measure_transfer_ms = lambda *a, **k: calls.append(1) or orig()
+        tok, key = e.prefill_device([1, 2, 3], 0.0, 0.9, seed=0)
+        n = e.stream_decode(
+            tok, lambda prev, t: True, 0.0, 0.9, chunk=4, limit=12,
+            key=key, first_prev=3,
+        )
+        assert n >= 1
+        assert len(calls) >= 1, "fused flow must still measure the I/T split"
+        assert e._pipeline_depth == 0
