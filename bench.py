@@ -71,35 +71,56 @@ def tinyllama_config(seq_len: int):
 
 def random_q40_params_on_device(cfg):
     """Synthetic Q40 params: random packed nibbles + constant scales, built
-    on device, layers UNSTACKED (the production q40 layout — see
-    engine/weights.py). Kernel throughput does not depend on the values."""
+    on device, layers UNSTACKED, in the production INTERLEAVED activation
+    basis (engine/weights.apply_basis_interleave) — random values are their
+    own permutation, so only the layout metadata and the gate_up/down
+    padded-basis shapes need constructing. Kernel throughput does not
+    depend on the values."""
     import jax
     import jax.numpy as jnp
 
     from distributed_llama_tpu.models.rope import build_rope_table
-    from distributed_llama_tpu.ops.q40 import QuantizedMatrix
-
-    from distributed_llama_tpu.ops.q40 import _d_padded, _n_padded
+    from distributed_llama_tpu.ops.q40 import (
+        QuantizedMatrix,
+        _d_padded,
+        _n_padded,
+        interleave_window,
+    )
 
     keys = iter(jax.random.split(jax.random.PRNGKey(0), 8 * cfg.n_layers + 8))
+    # DLT_INTERLEAVE=0 reverts the bench to the standard basis too, so the
+    # jnp.repeat kernel path (still live for wo/MoE/TP/SP/EP) stays
+    # re-measurable against the docs/PERF.md baseline row
+    import os
 
-    def qmat(n, d):
-        # the padding rule lives in ops.q40 — a local copy desyncing would
-        # silently route the bench onto the slow XLA fallback
-        n_pad, d_pad = _n_padded(n), _d_padded(d)
+    interleave_on = os.environ.get("DLT_INTERLEAVE") != "0"
+
+    def qmat(n, d, interleave=False, d_basis: int | None = None, halves: int = 1):
+        # the padding/window rules live in ops.q40 — a local copy desyncing
+        # would silently route the bench onto the slow XLA fallback
+        interleave = interleave and interleave_on
+        n_pad = _n_padded(n)
+        if d_basis is not None:
+            d = d_pad = halves * _n_padded(d_basis)  # interleaved output basis
+        else:
+            d_pad = _d_padded(d)
         qs = jax.random.bits(next(keys), (n_pad // 2, d_pad), dtype=jnp.uint8)
         scales = jnp.full((n_pad // 32, d_pad), 1.0 / 256, jnp.float32)
-        return QuantizedMatrix(qs, scales, n_logical=n, d_logical=d)
+        W = interleave_window(n_pad) if interleave else None
+        return QuantizedMatrix(
+            qs, scales, n_logical=n, d_logical=d,
+            interleaved=W is not None, packed_bn=0 if W is None else 2 * W,
+        )
 
     D, F, V, H, K, hd = (
         cfg.dim, cfg.hidden_dim, cfg.vocab_size, cfg.n_heads, cfg.n_kv_heads, cfg.head_size,
     )
     layers = [
         {
-            "qkv": qmat(D, (H + 2 * K) * hd),  # fused q|k|v (production layout)
-            "wo": qmat(H * hd, D),
-            "gate_up": qmat(D, 2 * F),  # fused gate|up
-            "down": qmat(F, D),
+            "qkv": qmat(D, (H + 2 * K) * hd, interleave=True),  # fused q|k|v
+            "wo": qmat(H * hd, D, d_basis=D),  # head-basis input: NOT interleaved
+            "gate_up": qmat(D, 2 * F, interleave=True, d_basis=F, halves=2),
+            "down": qmat(_n_padded(F), D, interleave=True, d_basis=D),
             "rms_att": jnp.ones(D, jnp.float32), "rms_ffn": jnp.ones(D, jnp.float32),
         }
         for _ in range(cfg.n_layers)
@@ -108,7 +129,7 @@ def random_q40_params_on_device(cfg):
         "embedding": jax.random.normal(next(keys), (V, D), jnp.float32) * 0.02,
         "layers": layers,
         "rms_final": jnp.ones(D, jnp.float32),
-        "wcls": qmat(D, V),
+        "wcls": qmat(D, V, interleave=True),
         "rope_table": jnp.asarray(build_rope_table(cfg)),
     }
 

@@ -378,6 +378,60 @@ def load_params(
     }
 
 
+def interleave_eligible(cfg: LlamaConfig) -> bool:
+    """The block-interleaved activation basis (ops.q40) applies when every
+    matmul input basis is kernel-eligible and the residual basis D is
+    unpadded (rmsnorm means over the width must not change). Dense llama
+    family only for now: MoE expert bases and per-shard TP bases keep the
+    standard layout."""
+    from distributed_llama_tpu.ops.q40 import _n_padded, interleave_window
+
+    if cfg.is_moe:
+        return False
+    D, F = cfg.dim, cfg.hidden_dim
+    if _n_padded(D) != D:
+        return False
+    return (
+        interleave_window(_n_padded(D)) is not None
+        and interleave_window(_n_padded(F)) is not None
+    )
+
+
+def apply_basis_interleave(params: Params, cfg: LlamaConfig) -> Params:
+    """Move a q40 params tree (fused qkv/gate_up layout, tp=1) into the
+    block-interleaved activation basis: an EXACT, load-time-only transform
+    (row/column gathers on device) that lets the kernel broadcast scales
+    with the cheap tiled form — no runtime permutes anywhere. See the
+    layout note in ops/q40.py. DLT_INTERLEAVE=0 disables."""
+    import os
+
+    from distributed_llama_tpu.ops import q40 as q
+
+    if os.environ.get("DLT_INTERLEAVE") == "0" or not interleave_eligible(cfg):
+        return params
+    D, F = cfg.dim, cfg.hidden_dim
+    out = dict(params)
+    out["embedding"] = q.interleave_vector(params["embedding"], D)
+    out["rms_final"] = q.interleave_vector(params["rms_final"], D)
+    out["wcls"] = q.interleave_input_rows(params["wcls"])
+    layers = []
+    for lp in params["layers"]:
+        lp = dict(lp)
+        lp["qkv"] = q.interleave_input_rows(lp["qkv"])  # input D; output heads
+        # wo: input is the attention-head basis (NOT interleaved — rope and
+        # head reshapes own that order); output columns move to basis D
+        lp["wo"] = q.interleaved_output_cols(lp["wo"], D)
+        lp["gate_up"] = q.interleaved_output_cols(
+            q.interleave_input_rows(lp["gate_up"]), F, halves=2
+        )
+        lp["down"] = q.interleaved_output_cols(q.interleave_input_rows(lp["down"]), D)
+        lp["rms_att"] = q.interleave_vector(lp["rms_att"], D)
+        lp["rms_ffn"] = q.interleave_vector(lp["rms_ffn"], D)
+        layers.append(lp)
+    out["layers"] = layers
+    return out
+
+
 def _synthetic_params(
     cfg: LlamaConfig, mat, ones, embedding, rope_table, layered: bool = False
 ) -> Params:

@@ -73,12 +73,21 @@ class QuantizedMatrix:
     plain array. The packed arrays may be PADDED up to tile-friendly sizes
     (padding carries zero *scales*, so padded rows/columns dequantize to
     exact zeros); ``n``/``d`` are the logical (unpadded) matmul dims.
+
+    ``interleaved``: the input rows are stored in the block-interleaved
+    basis (see :func:`interleave_input_rows`) — the kernel then broadcasts
+    scales with the cheap tiled ``pltpu.repeat`` (row p ← scale[p % nb])
+    instead of the per-32-row ``jnp.repeat`` expansion, measured ~+18% on
+    a 7B decode. ``packed_bn`` records the block_n the interleave was built
+    for (the kernel must tile with exactly that window).
     """
 
     qs: jax.Array  # uint8 [..., n_pad/2, d_pad]
     scales: jax.Array  # f32 [..., n_pad/32, d_pad]
     n_logical: int = 0  # 0 = unpadded (use packed size)
     d_logical: int = 0
+    interleaved: bool = False
+    packed_bn: int = 0
 
     @property
     def n(self) -> int:
@@ -105,7 +114,9 @@ class QuantizedMatrix:
         return jnp.bfloat16  # activation dtype the matmul expects
 
     def tree_flatten(self):
-        return (self.qs, self.scales), (self.n_logical, self.d_logical)
+        return (self.qs, self.scales), (
+            self.n_logical, self.d_logical, self.interleaved, self.packed_bn,
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -256,20 +267,147 @@ def concat_shard_packs(mats: list[QuantizedMatrix], axis: str) -> QuantizedMatri
     return QuantizedMatrix(qs, scales, n_logical=m0.n, d_logical=m0.d)
 
 
+def _packed_scale_index(n_pad: int, W: int) -> np.ndarray:
+    """Scale-row index of every packed-order row (concat lo|hi) of an
+    INTERLEAVED matrix: row p of window w belongs to block w*nb + p % nb."""
+    nbt = W // QK
+    p = np.arange(n_pad // 2)
+    lo = (p // W) * nbt + (p % nbt)
+    return np.concatenate([lo, n_pad // (2 * QK) + lo])
+
+
 def dequantize_tpu(qm: QuantizedMatrix) -> np.ndarray:
-    """Reference unpacking of the TPU layout → f32 [n, d] (for tests).
-    Trims any tile padding back to the logical dims."""
+    """Reference unpacking of the TPU layout → f32 [n, d] in the matrix's
+    OWN basis (for an interleaved matrix, the permuted row order its
+    activations use). Trims any tile padding back to the logical dims —
+    interleaved matrices keep the padded n (their basis has no trim)."""
     qs = np.asarray(qm.qs)
     scales = np.asarray(qm.scales)
     # half-split: low nibbles are logical rows [0, half), high [half, n_pad)
     lo = (qs & 0xF).astype(np.int8) - 8
     hi = (qs >> 4).astype(np.int8) - 8
     vals = np.concatenate([lo, hi], axis=0)
+    if qm.interleaved:
+        scale_full = scales[_packed_scale_index(qm.n_padded, qm.packed_bn // 2)]
+        return (vals.astype(np.float32) * scale_full)[:, : qm.d]
     scale_full = np.repeat(scales, QK, axis=0)
     return (vals.astype(np.float32) * scale_full)[: qm.n, : qm.d]
 
 
-def _make_q40_kernel(compute_dtype):
+# ---------------------------------------------------------------------------
+# Block-interleaved feature basis
+# ---------------------------------------------------------------------------
+#
+# The kernel's one remaining VPU heavyweight is the scale broadcast: scale
+# row b must multiply 32 CONSECUTIVE weight rows, which jnp.repeat expands
+# per grid step. pltpu.repeat is far cheaper (it tiles whole copies of the
+# scales tile: row p <- scale[p % nb]) but wrong for consecutive-row blocks.
+# Reordering the rows so that block membership IS p % nb makes it exact:
+# within every `window` of W = block_n/2 packed rows, position o holds
+# original feature (o % nb)*32 + o//nb (nb = W/32). The activations must
+# live in the same permuted basis — achieved at LOAD time by permuting
+# every producer of that basis (embedding columns, wo/down output columns,
+# rmsnorm vectors) with the same permutation, so no runtime permutes exist
+# anywhere. Scales stay in original block order (the permutation maps block
+# c of window w to scale row w*nb + c, exactly where it already is).
+# Measured: 9.98 -> ~8.5 ms/token on the 7B decode (docs/PERF.md round 5).
+
+
+def interleave_window(n_pad: int) -> int | None:
+    """The packed-row window the interleave is built for: half the kernel's
+    block_n tile. None = matrix not kernel-eligible (no interleave)."""
+    bn = _largest_divisor_tile(n_pad, BLOCK_N, 512)
+    # the hi half must start on a window boundary: (n_pad/2) % W == 0
+    if bn is None or (n_pad // 2) % (bn // 2) != 0:
+        return None
+    return bn // 2
+
+
+def interleave_perm(n: int, W: int) -> np.ndarray:
+    """Permutation over a feature axis of size ``n`` (a multiple of W):
+    new position p holds original feature perm[p]."""
+    nb = W // QK
+    o = np.arange(W)
+    idx = (o % nb) * QK + o // nb  # in-window source offsets
+    base = (np.arange(n) // W) * W
+    return base + idx[np.arange(n) % W]
+
+
+def interleave_input_rows(qm: QuantizedMatrix) -> QuantizedMatrix:
+    """Reorder a standard pack's input rows into the interleaved basis —
+    a pure row gather (scales unchanged); exact. The gather runs wherever
+    the pack lives (on device for a loaded model — no host round trip).
+    Returns the matrix unchanged if not kernel-eligible or already done."""
+    if qm.interleaved:
+        return qm
+    n_pad = qm.n_padded
+    W = interleave_window(n_pad)
+    if W is None:
+        return qm
+    half = n_pad // 2
+    perm = jnp.asarray(interleave_perm(half, W))
+    qs = jnp.take(jnp.asarray(qm.qs), perm, axis=0)
+    return QuantizedMatrix(
+        qs, qm.scales, qm.n_logical, qm.d_logical,
+        interleaved=True, packed_bn=2 * W,
+    )
+
+
+def interleaved_output_cols(
+    qm: QuantizedMatrix, n_consumer_logical: int, halves: int = 1
+) -> QuantizedMatrix:
+    """Permute a producer's OUTPUT columns into the consumer basis's
+    interleaved order, padding-aware: the consumer reads n_pad features, so
+    positions mapping to original features >= n_consumer_logical source a
+    zero-scale pad column (exact zeros). ``halves`` = 2 applies the same
+    per-half permutation to a fused [a|b] output (gate_up). The returned
+    d_logical grows to halves * n_pad_consumer — consumers must NOT trim."""
+    d_pad_src = qm.d_padded
+    npc = _n_padded(n_consumer_logical)
+    W = interleave_window(npc)
+    if W is None:
+        return qm
+    perm = interleave_perm(npc, W)
+    cols = np.empty(halves * npc, np.int64)
+    # a guaranteed zero-scale column for consumer-basis pad positions
+    has_pad_col = d_pad_src > qm.d
+    for h in range(halves):
+        src_base = h * n_consumer_logical
+        valid = perm < n_consumer_logical
+        if not has_pad_col and not valid.all():
+            raise ValueError(
+                "consumer basis needs pad columns but the producer has no "
+                f"zero d-padding (d={qm.d}, d_pad={d_pad_src})"
+            )
+        cols[h * npc : (h + 1) * npc] = np.where(
+            valid, src_base + perm, d_pad_src - 1
+        )
+    cols_j = jnp.asarray(cols)
+    return QuantizedMatrix(
+        jnp.take(jnp.asarray(qm.qs), cols_j, axis=1),
+        jnp.take(jnp.asarray(qm.scales), cols_j, axis=1),
+        qm.n_logical, halves * npc,
+        interleaved=qm.interleaved, packed_bn=qm.packed_bn,
+    )
+
+
+def interleave_vector(v, n_logical: int):
+    """Permute a feature vector (rmsnorm weight) or the last axis of an
+    embedding table into the interleaved basis; pads with zeros when the
+    basis is padded."""
+    npc = _n_padded(n_logical)
+    W = interleave_window(npc)
+    if W is None:
+        return v
+    perm = interleave_perm(npc, W)
+    v = jnp.asarray(v)
+    if v.shape[-1] < npc:
+        pad = [(0, 0)] * (v.ndim - 1) + [(0, npc - v.shape[-1])]
+        v = jnp.pad(v, pad)
+    return jnp.take(v, jnp.asarray(perm), axis=-1)
+
+
+def _make_q40_kernel(compute_dtype, interleaved: bool = False, interpret: bool = False):
     """Kernel factory: one (d-tile, n-tile) grid step dequantizes the weight
     tile in VMEM and accumulates into the f32 accumulator.
 
@@ -298,15 +436,29 @@ def _make_q40_kernel(compute_dtype):
         # (dropping the redundant & 0xF is worth ~25% on the VPU-bound unpack)
         hi = (qs >> 4).astype(compute_dtype)
         bn2, bd = qs.shape
-        # lo/hi rows are CONSECUTIVE logical rows: each scale row broadcasts
-        # over its 32-row block. jnp.repeat expands the SMALL scales tile to
-        # [bn2, bd] and multiplies in 2-D — reshaping the big nibble tile to
-        # [blocks, 32, bd] and back instead costs Mosaic relayouts on the
-        # large array (measured 61 -> 68 tok/s end-to-end on a 7B decode).
-        # NOT pltpu.repeat: that tiles whole copies (s[r % nb], not the
-        # needed s[r // 32]) — numerically wrong here.
-        wlo = lo * jnp.repeat(slo_ref[:].astype(compute_dtype), QK, axis=0)
-        whi = hi * jnp.repeat(shi_ref[:].astype(compute_dtype), QK, axis=0)
+        if interleaved:
+            # block-interleaved rows: membership of row p is p % nb, so the
+            # scale broadcast is a whole-tile tiling — pltpu.repeat on TPU
+            # (measured ~+18% over the jnp.repeat expansion on a 7B decode),
+            # jnp.tile (same semantics) in interpret mode
+            if interpret:
+                wlo = lo * jnp.tile(slo_ref[:].astype(compute_dtype), (QK, 1))
+                whi = hi * jnp.tile(shi_ref[:].astype(compute_dtype), (QK, 1))
+            else:
+                wlo = lo * pltpu.repeat(slo_ref[:].astype(compute_dtype), QK, 0)
+                whi = hi * pltpu.repeat(shi_ref[:].astype(compute_dtype), QK, 0)
+        else:
+            # CONSECUTIVE logical rows: each scale row broadcasts over its
+            # 32-row block. jnp.repeat expands the SMALL scales tile to
+            # [bn2, bd] and multiplies in 2-D — reshaping the big nibble
+            # tile to [blocks, 32, bd] and back instead costs Mosaic
+            # relayouts on the large array (measured 61 -> 68 tok/s
+            # end-to-end on a 7B decode). pltpu.repeat would be faster
+            # still but tiles whole copies (s[r % nb], not s[r // 32]) —
+            # numerically wrong for this row order; the interleaved layout
+            # above exists precisely to make it right.
+            wlo = lo * jnp.repeat(slo_ref[:].astype(compute_dtype), QK, axis=0)
+            whi = hi * jnp.repeat(shi_ref[:].astype(compute_dtype), QK, axis=0)
         acc_ref[:] += jnp.dot(xlo_ref[:], wlo, preferred_element_type=jnp.float32)
         acc_ref[:] += jnp.dot(xhi_ref[:], whi, preferred_element_type=jnp.float32)
 
@@ -337,7 +489,12 @@ def q40_matmul(
     # (T, bn/2) needs bn/2 % 128 == 0 and the scales tile (bn/64, bd) needs
     # bn/64 % 8 == 0 (mosaic sublane/lane tiling rules) — smaller matrices
     # take the XLA fallback
-    block_n = _largest_divisor_tile(np_, block_n, 512)
+    if qm.interleaved:
+        # the row interleave was built for exactly this window; any other
+        # block_n would pair wrong scales with wrong rows
+        block_n = qm.packed_bn
+    else:
+        block_n = _largest_divisor_tile(np_, block_n, 512)
     block_d = _largest_divisor_tile(dp, block_d, 128)
     if block_n is None or block_d is None:
         return _q40_matmul_fallback(x, qm)
@@ -347,6 +504,12 @@ def q40_matmul(
         interpret = jax.devices()[0].platform == "cpu"
 
     if x.shape[-1] != np_:
+        if qm.interleaved:
+            # the interleaved basis intersperses pad features; a narrower x
+            # is a basis mismatch, not something end-padding can fix
+            raise ValueError(
+                f"interleaved matmul needs x width {np_}, got {x.shape[-1]}"
+            )
         x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
     compute_dtype = jnp.float32 if interpret else jnp.bfloat16
     xb = x.astype(compute_dtype)
@@ -356,7 +519,7 @@ def q40_matmul(
     # views over the same array — window j for the low nibbles, window
     # nj + j (the upper half) for the high nibbles. Contiguous, gather-free.
     out = pl.pallas_call(
-        _make_q40_kernel(compute_dtype),
+        _make_q40_kernel(compute_dtype, interleaved=qm.interleaved, interpret=interpret),
         grid=grid,
         in_specs=[
             pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
@@ -380,7 +543,18 @@ def q40_matmul(
     # magnitude, so bf16 accumulation error here would dominate the result
     # (measured 6x accuracy loss) — f32 makes it the exact sum of the same
     # bf16 x values the kernel consumed.
-    xsum = jnp.sum(xb.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
+    if qm.interleaved:
+        # interleaved rows: window W holds its blocks' elements strided by
+        # nb (position o = q*nb + c belongs to block c of the window), so
+        # the per-block sum groups [W] as [QK, nb]; the flattened (w, c)
+        # order matches the scales array's block order exactly
+        W = qm.packed_bn // 2
+        nbt = W // QK
+        xsum = jnp.sum(
+            xb.astype(jnp.float32).reshape(T, np_ // W, QK, nbt), axis=2
+        ).reshape(T, np_ // QK)
+    else:
+        xsum = jnp.sum(xb.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
     corr = jax.lax.dot_general(
         xsum, qm.scales,
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -437,8 +611,18 @@ def _q40_matmul_fallback(x: jax.Array, qm: QuantizedMatrix) -> jax.Array:
     hi = (qm.qs >> 4).astype(jnp.int8) - 8
     # half-split: low nibbles are rows [0, half), high [half, n_pad)
     w_int = jnp.concatenate([lo, hi], axis=-2)
-    w = w_int.astype(jnp.float32).reshape(-1, QK, dp) * qm.scales[..., None, :]
-    w = w.reshape(np_, dp)
+    if qm.interleaved:
+        if x.shape[-1] != np_:
+            # same contract as the kernel path: end-padding cannot fix a
+            # basis mismatch (pad features are interspersed, not trailing)
+            raise ValueError(
+                f"interleaved matmul needs x width {np_}, got {x.shape[-1]}"
+            )
+        idx = jnp.asarray(_packed_scale_index(np_, qm.packed_bn // 2))
+        w = w_int.astype(jnp.float32) * qm.scales[idx]
+    else:
+        w = w_int.astype(jnp.float32).reshape(-1, QK, dp) * qm.scales[..., None, :]
+        w = w.reshape(np_, dp)
     if x.shape[-1] != np_:
         x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
     out = jax.lax.dot_general(

@@ -88,6 +88,75 @@ class TestMatmul:
         assert rel < 0.12, rel
 
 
+class TestInterleavedBasis:
+    """The block-interleaved activation basis (ops.q40 layout note): input
+    rows reordered so scale broadcast is a whole-tile tiling. The transform
+    must be exact — kernel, fallback and dequantize must all agree with the
+    standard layout modulo the basis permutation."""
+
+    def _pair(self, n=512, d=256, seed=5):
+        from distributed_llama_tpu.ops.q40 import interleave_input_rows
+
+        rng = np.random.RandomState(seed)
+        w = rng.randn(n, d).astype(np.float32) / np.sqrt(n)
+        qm = quantize_q40_tpu(w)
+        qi = interleave_input_rows(qm)
+        assert qi.interleaved and qi.packed_bn > 0
+        return qm, qi
+
+    def test_dequant_is_row_permutation(self):
+        from distributed_llama_tpu.ops.q40 import interleave_perm
+
+        qm, qi = self._pair()
+        std = dequantize_tpu(qm)  # [n, d] logical order
+        il = dequantize_tpu(qi)  # [n_pad, d] interleaved order
+        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
+        np.testing.assert_array_equal(il, std[perm])
+
+    @pytest.mark.parametrize("T", [1, 8])
+    def test_interleaved_kernel_matches_fallback(self, T):
+        from distributed_llama_tpu.ops.q40 import _q40_matmul_fallback, interleave_perm
+
+        qm, qi = self._pair()
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(T, qm.n_padded).astype(np.float32))
+        # x in the interleaved basis == standard x with permuted features
+        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
+        want_std = np.asarray(_q40_matmul_fallback(x[:, np.argsort(perm)], qm))
+        got_fb = np.asarray(_q40_matmul_fallback(x, qi))
+        np.testing.assert_allclose(got_fb, want_std[:, : qi.d], rtol=1e-4, atol=1e-4)
+        got_kernel = np.asarray(q40_matmul(x, qi, interpret=True))
+        scale = np.abs(want_std).max()
+        np.testing.assert_allclose(
+            got_kernel / scale, want_std[:, : qi.d] / scale, atol=2e-2
+        )
+
+    def test_output_cols_pad_positions_are_zero(self):
+        """interleaved_output_cols on a padded consumer basis must emit
+        exact zeros at the interspersed pad positions (they feed silu/mul
+        and the next matmul's zero-scale rows)."""
+        from distributed_llama_tpu.ops.q40 import (
+            interleave_perm,
+            interleave_window,
+            interleaved_output_cols,
+        )
+        from distributed_llama_tpu.ops.q40 import _n_padded
+
+        rng = np.random.RandomState(9)
+        F = 544  # pads to 1024 -> basis has interspersed pad positions
+        npc = _n_padded(F)
+        w = rng.randn(512, 2 * F).astype(np.float32) / 16  # fused [a|b]
+        qm = quantize_q40_tpu(w)
+        qo = interleaved_output_cols(qm, F, halves=2)
+        assert qo.d == 2 * npc
+        deq = dequantize_tpu(qo)  # columns in the consumer basis
+        perm = interleave_perm(npc, interleave_window(npc))
+        pad_cols = np.concatenate([
+            np.nonzero(perm >= F)[0], npc + np.nonzero(perm >= F)[0]
+        ])
+        assert np.all(deq[:, pad_cols] == 0.0)
+
+
 class TestEnvTileValidation:
     def test_bad_env_tile_fails_at_kernel_use_not_import(self, monkeypatch):
         """A bad DLT_BN value must not make the package unimportable
