@@ -100,9 +100,12 @@ def random_q40_params_on_device(cfg):
         # would silently route the bench onto the slow XLA fallback
         interleave = interleave and interleave_on
         n_pad = _n_padded(n)
-        if d_basis is not None:
+        if d_basis is not None and interleave_on:
             d = d_pad = halves * _n_padded(d_basis)  # interleaved output basis
         else:
+            # standard basis keeps the real production shapes (trimmed
+            # gate_up output, runtime-padded down input) so a
+            # DLT_INTERLEAVE=0 run reproduces the documented baseline
             d_pad = _d_padded(d)
         qs = jax.random.bits(next(keys), (n_pad // 2, d_pad), dtype=jnp.uint8)
         scales = jnp.full((n_pad // 32, d_pad), 1.0 / 256, jnp.float32)
@@ -120,7 +123,7 @@ def random_q40_params_on_device(cfg):
             "qkv": qmat(D, (H + 2 * K) * hd, interleave=True),  # fused q|k|v
             "wo": qmat(H * hd, D, d_basis=D),  # head-basis input: NOT interleaved
             "gate_up": qmat(D, 2 * F, interleave=True, d_basis=F, halves=2),
-            "down": qmat(_n_padded(F), D, interleave=True, d_basis=D),
+            "down": qmat(_n_padded(F) if interleave_on else F, D, interleave=True, d_basis=D),
             "rms_att": jnp.ones(D, jnp.float32), "rms_ffn": jnp.ones(D, jnp.float32),
         }
         for _ in range(cfg.n_layers)

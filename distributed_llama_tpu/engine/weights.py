@@ -381,13 +381,10 @@ def load_params(
 def interleave_eligible(cfg: LlamaConfig) -> bool:
     """The block-interleaved activation basis (ops.q40) applies when every
     matmul input basis is kernel-eligible and the residual basis D is
-    unpadded (rmsnorm means over the width must not change). Dense llama
-    family only for now: MoE expert bases and per-shard TP bases keep the
-    standard layout."""
+    unpadded (rmsnorm means over the width must not change). Single-chip
+    only for now: per-shard TP/SP/EP bases keep the standard layout."""
     from distributed_llama_tpu.ops.q40 import _n_padded, interleave_window
 
-    if cfg.is_moe:
-        return False
     D, F = cfg.dim, cfg.hidden_dim
     if _n_padded(D) != D:
         return False
@@ -409,7 +406,14 @@ def apply_basis_interleave(params: Params, cfg: LlamaConfig) -> Params:
 
     if os.environ.get("DLT_INTERLEAVE") == "0" or not interleave_eligible(cfg):
         return params
+    from distributed_llama_tpu.ops.q40 import (
+        _n_padded,
+        interleave_perm,
+        interleave_window,
+    )
+
     D, F = cfg.dim, cfg.hidden_dim
+    perm_d = jnp.asarray(interleave_perm(_n_padded(D), interleave_window(_n_padded(D))))
     out = dict(params)
     out["embedding"] = q.interleave_vector(params["embedding"], D)
     out["rms_final"] = q.interleave_vector(params["rms_final"], D)
@@ -421,12 +425,33 @@ def apply_basis_interleave(params: Params, cfg: LlamaConfig) -> Params:
         # wo: input is the attention-head basis (NOT interleaved — rope and
         # head reshapes own that order); output columns move to basis D
         lp["wo"] = q.interleaved_output_cols(lp["wo"], D)
-        lp["gate_up"] = q.interleaved_output_cols(
-            q.interleave_input_rows(lp["gate_up"]), F, halves=2
-        )
-        lp["down"] = q.interleaved_output_cols(q.interleave_input_rows(lp["down"]), D)
+        if "experts" in lp:
+            # MoE: each expert's FFN follows the dense pattern — gate_up
+            # reads D / writes its own F basis, down reads F / writes D;
+            # the router (a plain array) reads D, so its rows permute
+            lp["router"] = jnp.take(jnp.asarray(lp["router"]), perm_d, axis=0)
+            lp["experts"] = [
+                {
+                    "gate_up": q.interleaved_output_cols(
+                        q.interleave_input_rows(e["gate_up"]), F, halves=2
+                    ),
+                    "down": q.interleaved_output_cols(
+                        q.interleave_input_rows(e["down"]), D
+                    ),
+                }
+                for e in lp["experts"]
+            ]
+        else:
+            lp["gate_up"] = q.interleaved_output_cols(
+                q.interleave_input_rows(lp["gate_up"]), F, halves=2
+            )
+            lp["down"] = q.interleaved_output_cols(q.interleave_input_rows(lp["down"]), D)
         lp["rms_att"] = q.interleave_vector(lp["rms_att"], D)
         lp["rms_ffn"] = q.interleave_vector(lp["rms_ffn"], D)
+        if "rms_moe" in lp:
+            lp["rms_moe"] = q.interleave_vector(lp["rms_moe"], D)
+        if "rms_ffn2" in lp:
+            lp["rms_ffn2"] = q.interleave_vector(lp["rms_ffn2"], D)
         layers.append(lp)
     out["layers"] = layers
     return out

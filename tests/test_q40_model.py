@@ -77,3 +77,32 @@ def test_q40_interleaved_basis_matches_standard(tmp_path, monkeypatch):
     g = e_int.decode_step(7)
     w = e_std.decode_step(7)
     np.testing.assert_allclose(g, w, rtol=2e-2, atol=2e-2)
+
+
+def test_q40_interleaved_basis_moe(tmp_path, monkeypatch):
+    """MoE expert banks follow the interleaved basis too (per-expert
+    gate_up/down + permuted router rows): parity vs the standard layout."""
+    from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct
+
+    spec = tiny_spec(
+        arch_type=ArchType.MIXTRAL, n_experts=4, n_active_experts=2,
+        hidden_act=HiddenAct.SILU, dim=512, hidden_dim=512, n_heads=4,
+        n_kv_heads=4, vocab_size=96, seq_len=48,
+        weights_float_type=FloatType.Q40,
+    )
+    tensors = random_tensors(spec, seed=5)
+    path = str(tmp_path / "il_moe.m")
+    write_model_file(path, spec, tensors)
+
+    prompt = list(np.random.RandomState(2).randint(1, 96, 34))  # bucketed-range T
+    e_int = InferenceEngine(path, dtype="q40")
+    assert e_int.params["layers"][0]["experts"][0]["gate_up"].interleaved
+    got = e_int.forward(prompt)
+    g_step = e_int.decode_step(7)
+
+    monkeypatch.setenv("DLT_INTERLEAVE", "0")
+    e_std = InferenceEngine(path, dtype="q40")
+    want = e_std.forward(prompt)
+    w_step = e_std.decode_step(7)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(g_step, w_step, rtol=2e-2, atol=2e-2)
