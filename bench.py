@@ -69,6 +69,34 @@ def tinyllama_config(seq_len: int):
     )
 
 
+def mixtral_shaped_config(seq_len: int):
+    """A Mixtral-shaped MoE config scaled to one chip's HBM (8 experts
+    top-2 like Mixtral 8x7B; dim/head geometry of the 7B class, hidden and
+    layer count shrunk so the q40 expert banks fit): the multi-model perf
+    probe behind `bench.py --mixtral-only` (BASELINE config 3's shape
+    class — the reference publishes no Mixtral number to compare against)."""
+    from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct, RopeType
+    from distributed_llama_tpu.models.config import LlamaConfig
+
+    return LlamaConfig(
+        arch=ArchType.MIXTRAL,
+        dim=4096,
+        hidden_dim=4096,
+        n_layers=8,
+        n_heads=32,
+        n_kv_heads=8,
+        vocab_size=32000,
+        seq_len=seq_len,
+        head_size=128,
+        kv_dim=1024,
+        hidden_act=HiddenAct.SILU,
+        rope_type=RopeType.FALCON,
+        rope_theta=10000.0,
+        n_experts=8,
+        n_active_experts=2,
+    )
+
+
 def random_q40_params_on_device(cfg):
     """Synthetic Q40 params: random packed nibbles + constant scales, built
     on device, layers UNSTACKED, in the production INTERLEAVED activation
@@ -87,7 +115,7 @@ def random_q40_params_on_device(cfg):
         interleave_window,
     )
 
-    keys = iter(jax.random.split(jax.random.PRNGKey(0), 8 * cfg.n_layers + 8))
+    keys = iter(jax.random.split(jax.random.PRNGKey(0), (2 * cfg.n_experts + 8) * cfg.n_layers + 8))
     # DLT_INTERLEAVE=0 reverts the bench to the standard basis too, so the
     # jnp.repeat kernel path (still live for wo/MoE/TP/SP/EP) stays
     # re-measurable against the docs/PERF.md baseline row
@@ -118,16 +146,30 @@ def random_q40_params_on_device(cfg):
     D, F, V, H, K, hd = (
         cfg.dim, cfg.hidden_dim, cfg.vocab_size, cfg.n_heads, cfg.n_kv_heads, cfg.head_size,
     )
-    layers = [
-        {
+
+    def layer():
+        lp = {
             "qkv": qmat(D, (H + 2 * K) * hd, interleave=True),  # fused q|k|v
             "wo": qmat(H * hd, D, d_basis=D),  # head-basis input: NOT interleaved
-            "gate_up": qmat(D, 2 * F, interleave=True, d_basis=F, halves=2),
-            "down": qmat(_n_padded(F) if interleave_on else F, D, interleave=True, d_basis=D),
             "rms_att": jnp.ones(D, jnp.float32), "rms_ffn": jnp.ones(D, jnp.float32),
         }
-        for _ in range(cfg.n_layers)
-    ]
+        if cfg.is_moe:
+            lp["router"] = jax.random.normal(next(keys), (D, cfg.n_experts), jnp.float32) * 0.05
+            lp["experts"] = [
+                {
+                    "gate_up": qmat(D, 2 * F, interleave=True, d_basis=F, halves=2),
+                    "down": qmat(_n_padded(F) if interleave_on else F, D,
+                                 interleave=True, d_basis=D),
+                }
+                for _ in range(cfg.n_experts)
+            ]
+        else:
+            lp["gate_up"] = qmat(D, 2 * F, interleave=True, d_basis=F, halves=2)
+            lp["down"] = qmat(_n_padded(F) if interleave_on else F, D,
+                              interleave=True, d_basis=D)
+        return lp
+
+    layers = [layer() for _ in range(cfg.n_layers)]
     return {
         "embedding": jax.random.normal(next(keys), (V, D), jnp.float32) * 0.02,
         "layers": layers,
@@ -375,5 +417,10 @@ if __name__ == "__main__":
         main_single("q40")
     elif "--bf16-only" in sys.argv:
         main_single("bf16")
+    elif "--mixtral-only" in sys.argv:
+        # multi-model probe (BASELINE config 3's shape class): one-chip
+        # Mixtral-shaped MoE decode/prefill; not part of the default line —
+        # run on demand, numbers recorded in docs/PERF.md
+        print(json.dumps(run(mixtral_shaped_config(1024), "mixtral_shaped_moe", weights="q40")))
     else:
         main()
