@@ -64,6 +64,40 @@ def merge_partials(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
+def blocked_partials(
+    qg: jax.Array,  # [T, K, M, hd] f32 grouped queries
+    keys,  # local cache slice [Sl, K, hd] (array or QuantizedKV)
+    values,
+    q_pos: jax.Array,  # [T] absolute positions (ascending)
+    base: jax.Array,  # absolute position of local slot 0
+    chunk: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Online-softmax partials of T queries over a LOCAL cache slice with a
+    DYNAMIC chunk bound: slots past the last live position (q_pos[-1]) are
+    never read. The (m, l, o) triple feeds a cross-shard merge (sequence
+    parallelism's pmax/psum) or a local normalization. A shard whose slice
+    holds no live slots returns (-inf, 0, 0) — a zero contribution after
+    any merge. Requires Sl % chunk == 0."""
+    T, K, M, hd = qg.shape
+    Sl = keys.shape[0]
+    live = jnp.clip(q_pos[-1] + 1 - base, 0, Sl)
+    n_chunks = jax.lax.div(live + chunk - 1, chunk)
+
+    def body(i, carry):
+        m, l, o = carry
+        start = i * chunk
+        kc = kvc.slice_rows(keys, start, chunk)
+        vc = kvc.slice_rows(values, start, chunk)
+        k_pos = base + start + jnp.arange(chunk)
+        ms, ls, os_ = chunk_attention(qg, kc, vc, q_pos, k_pos)
+        return merge_partials(m, l, o, ms, ls, os_)
+
+    m0 = jnp.full((T, K, M), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((T, K, M), jnp.float32)
+    o0 = jnp.zeros((T, K, M, hd), jnp.float32)
+    return jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+
+
 def blocked_attention(
     qg: jax.Array,  # [T, K, M, hd] f32 grouped queries
     keys,  # cache half [S, K, hd] (array or QuantizedKV)
@@ -81,22 +115,8 @@ def blocked_attention(
     otherwise). The boundary chunk's causal edge is masked inside
     :func:`chunk_attention` by position comparison.
     """
-    T, K, M, hd = qg.shape
-    S = keys.shape[0]
-    q_pos = pos + jnp.arange(T)
-    n_chunks = jax.lax.div(pos + T + chunk - 1, chunk)
-
-    def body(i, carry):
-        m, l, o = carry
-        start = i * chunk
-        kc = kvc.slice_rows(keys, start, chunk)
-        vc = kvc.slice_rows(values, start, chunk)
-        k_pos = start + jnp.arange(chunk)
-        ms, ls, os_ = chunk_attention(qg, kc, vc, q_pos, k_pos)
-        return merge_partials(m, l, o, ms, ls, os_)
-
-    m0 = jnp.full((T, K, M), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((T, K, M), jnp.float32)
-    o0 = jnp.zeros((T, K, M, hd), jnp.float32)
-    m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+    T = qg.shape[0]
+    # same chunk scan as the sequence-parallel local-slice partials, with
+    # the whole cache as the "local slice" (base 0) and a local normalize
+    m, l, o = blocked_partials(qg, keys, values, pos + jnp.arange(T), 0, chunk)
     return o / jnp.maximum(l, 1e-30)[..., None]

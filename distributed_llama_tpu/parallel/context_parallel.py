@@ -29,7 +29,11 @@ import jax
 import jax.numpy as jnp
 
 from distributed_llama_tpu.ops import kv_cache as kvc
-from distributed_llama_tpu.ops.attention import chunk_attention, merge_partials
+from distributed_llama_tpu.ops.attention import (
+    blocked_partials,
+    chunk_attention,
+    merge_partials,
+)
 from distributed_llama_tpu.parallel.tensor_parallel import TransferProbeMixin
 
 # the online-softmax primitives live in ops.attention (shared with the dense
@@ -86,6 +90,14 @@ def ring_attention(
     return out.reshape(Tq, H, q.shape[-1])
 
 
+# key-axis chunk of the blocked local-slice scan (see ops.attention): local
+# slices that are a multiple of this use a dynamic chunk bound — slots past
+# the live position are never read, so sp decode cost follows the LIVE
+# context, not the allocated S/sp slice (the dense path's round-5 blocked-
+# attention win applied to the sequence-parallel slice scan)
+SP_ATT_CHUNK = 512
+
+
 def sp_sharded_attention(
     q: jax.Array,  # [Tq, H, hd] query rows (replicated across the axis)
     k_local: jax.Array,  # [Sl, K, hd] local KV-cache slice (sequence-sharded)
@@ -94,17 +106,26 @@ def sp_sharded_attention(
     axis_name: str,
 ) -> jax.Array:
     """Attention of Tq query rows over a sequence-sharded KV cache. Every
-    device computes partials over its slice; one pmax + two psums merge
-    them (cross-device online-softmax merge). Returns [Tq, H, hd]
-    (replicated). Tq==1 is the decode step; Tq>1 is the chunked mid-context
-    prefill."""
+    device computes partials over its slice — blocked with a dynamic bound
+    when the slice is chunk-divisible, one masked pass otherwise — and one
+    pmax + two psums merge them (cross-device online-softmax merge).
+    Returns [Tq, H, hd] (replicated). Tq==1 is the decode step; Tq>1 is
+    the chunked mid-context prefill."""
     idx = jax.lax.axis_index(axis_name)
     Sl, K, hd = k_local.shape
     Tq, H = q.shape[0], q.shape[1]
     kv_mul = H // K
     qg = q.reshape(Tq, K, kv_mul, hd).astype(jnp.float32)
-    positions = idx * Sl + jnp.arange(Sl)
-    m, l, o = _chunk_attention(qg, k_local, v_local, q_pos, positions)
+    base = idx * Sl
+    if Sl % SP_ATT_CHUNK == 0 and Sl > SP_ATT_CHUNK:
+        m, l, o = blocked_partials(qg, k_local, v_local, q_pos, base, SP_ATT_CHUNK)
+        # the cross-shard pmax needs a finite max everywhere (a no-live-slot
+        # shard reports -inf); the merge algebra is invariant to which
+        # reference max is used, so clamp like chunk_attention's safe_m
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+    else:
+        positions = base + jnp.arange(Sl)
+        m, l, o = _chunk_attention(qg, k_local, v_local, q_pos, positions)
     g_m = jax.lax.pmax(m, axis_name)
     scale = jnp.exp(m - g_m)
     g_l = jax.lax.psum(l * scale, axis_name)

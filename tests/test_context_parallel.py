@@ -199,6 +199,40 @@ class TestSequenceParallelEngine:
             f"{dense_ms*1e3:.1f} ms (O(seq_len) regression guard)"
         )
 
+    def test_sp_blocked_local_slice_matches_full_scan(self, tmp_path, monkeypatch):
+        """Local slices >= 2*SP_ATT_CHUNK scan with a dynamic blocked bound
+        (slots past the live position unread); results must match both the
+        full-slice scan and the dense engine, prefill and decode."""
+        import distributed_llama_tpu.parallel.context_parallel as cp
+
+        from tests.model_utils import random_tensors, tiny_spec, write_model_file
+        from distributed_llama_tpu.engine import InferenceEngine
+
+        spec = tiny_spec(
+            dim=64, n_heads=8, n_kv_heads=4, hidden_dim=128,
+            vocab_size=96, seq_len=4096,
+        )
+        path = str(tmp_path / "sp_blocked.m")
+        write_model_file(path, spec, random_tensors(spec, seed=6))
+        prompt = list(np.random.RandomState(3).randint(1, 96, 40))
+
+        monkeypatch.setattr(cp, "SP_ATT_CHUNK", 512)
+        esp = InferenceEngine(path, dtype=jnp.float32, sp=4)
+        assert esp.cache[0][0].shape[0] // 1 == 4096  # global shape
+        got_p = esp.prefill(prompt)
+        got_d = esp.decode_step(7)
+
+        monkeypatch.setattr(cp, "SP_ATT_CHUNK", 1 << 30)  # force full scan
+        e_full = InferenceEngine(path, dtype=jnp.float32, sp=4)
+        want_p = e_full.prefill(prompt)
+        want_d = e_full.decode_step(7)
+        np.testing.assert_allclose(got_p, want_p, rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(got_d, want_d, rtol=3e-4, atol=3e-4)
+
+        dense = InferenceEngine(path, dtype=jnp.float32)
+        np.testing.assert_allclose(dense.prefill(prompt), want_p, rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(dense.decode_step(7), want_d, rtol=3e-4, atol=3e-4)
+
     def test_sp_greedy_stream_matches_dense(self, tmp_path):
         from distributed_llama_tpu.engine import InferenceEngine
 
