@@ -186,6 +186,13 @@ def _moe_dense(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
     weights = router_weights(cfg, xn, lp["router"])  # [T, E] f32
     from distributed_llama_tpu.models.llama import _activation
 
+    if lp["moe_up"].dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        # some XLA:CPU builds cannot EXECUTE bf16xbf16 batched dots
+        # ("DotThunk ... BF16 x BF16" runtime errors); f32 operands cost
+        # nothing on the dev/test surface and TPU never takes this branch
+        lp = dict(lp)
+        for k_ in ("moe_up", "moe_gate", "moe_down"):
+            lp[k_] = lp[k_].astype(jnp.float32)
     xc = xn.astype(lp["moe_up"].dtype)
     gate = jnp.einsum(
         "td,edh->teh", xc, lp["moe_gate"], preferred_element_type=jnp.float32,
