@@ -233,3 +233,20 @@ class TestExpertParallelEngine:
         path = _mixtral_file(tmp_path)
         with pytest.raises(ValueError, match="do not compose"):
             InferenceEngine(path, dtype=jnp.float32, ep=2, sp=2)
+
+    def test_engine_ep_i8_cache(self, tmp_path, drop_free):
+        """EP composes with the quantized KV cache (QuantizedKV halves
+        replicated-over-ep, tp-sharded when composed): parity within i8
+        quantization noise of the dense f32-cache engine."""
+        from distributed_llama_tpu.engine import InferenceEngine
+
+        path = _mixtral_file(tmp_path)
+        prompt = [1, 5, 9, 13, 2, 7]
+        dense = InferenceEngine(path, dtype=jnp.float32)
+        want = dense.prefill(prompt)
+        ep_engine = InferenceEngine(path, dtype=jnp.float32, ep=2, cache_dtype="i8")
+        got = ep_engine.prefill(prompt)
+        import jax.numpy as _jnp
+        assert ep_engine.cache[0][0].data.dtype == _jnp.int8
+        scale = np.abs(want).max()
+        assert np.abs(got - want).max() / scale < 0.05  # i8 cache noise bound
