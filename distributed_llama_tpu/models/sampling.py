@@ -40,14 +40,42 @@ def sample_token(
     logits = logits / temperature
     if 0.0 < topp < 1.0:
         probs = jax.nn.softmax(logits)
-        sorted_probs = jnp.sort(probs)[::-1]
-        cum = jnp.cumsum(sorted_probs)
-        # smallest set whose cumulative prob exceeds topp (inclusive of the
-        # crossing element, like the reference's last_idx logic)
-        cutoff_count = jnp.sum(cum - sorted_probs < topp)
-        threshold = sorted_probs[jnp.maximum(cutoff_count - 1, 0)]
+        threshold = _topp_threshold(probs, topp)
         logits = jnp.where(probs >= threshold, logits, -jnp.inf)
     return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+# top-k width of the nucleus-threshold fast path: when the top-p mass sits
+# inside the largest TOPP_FAST_K probabilities (virtually always for
+# topp <= 0.95 on a trained model), the threshold comes from one top_k
+# instead of a full-vocab sort; a lax.cond falls back to the sort otherwise,
+# so the result is EXACT either way
+TOPP_FAST_K = 128
+
+
+def _topp_threshold(probs: jax.Array, topp: jax.Array) -> jax.Array:
+    """The smallest probability inside the top-p nucleus (inclusive of the
+    crossing element, like the reference's last_idx logic,
+    src/tokenizer.cpp:334-369). Exact: the top-k fast path is used only
+    when the nucleus provably fits in the top k (prefix mass at rank i is
+    monotone, so no index >= k can be counted once cum[k-1] >= topp)."""
+    k = min(TOPP_FAST_K, probs.shape[-1])
+    top_vals, _ = jax.lax.top_k(probs, k)
+    cum_k = jnp.cumsum(top_vals)
+
+    def fast(_):
+        cutoff = jnp.sum(cum_k - top_vals < topp)
+        return top_vals[jnp.maximum(cutoff - 1, 0)]
+
+    def full(_):
+        sorted_probs = jnp.sort(probs)[::-1]
+        cum = jnp.cumsum(sorted_probs)
+        cutoff = jnp.sum(cum - sorted_probs < topp)
+        return sorted_probs[jnp.maximum(cutoff - 1, 0)]
+
+    if k == probs.shape[-1]:
+        return fast(None)
+    return jax.lax.cond(cum_k[-1] >= topp, fast, full, None)
 
 
 def _sample_token_dynamic(
@@ -55,14 +83,12 @@ def _sample_token_dynamic(
 ) -> jax.Array:
     """Same semantics with runtime-valued temperature/topp: the greedy and
     top-p decisions become ``jnp.where`` selects. Draw-identical to the static
-    path for the same key (the filtered-logit construction matches), so
-    chunked and single-dispatch decode produce the same stream per seed."""
+    path for the same key (the filtered-logit construction matches — the
+    fast-path threshold equals the full-sort threshold exactly), so chunked
+    and single-dispatch decode produce the same stream per seed."""
     scaled = logits / jnp.maximum(temperature, 1e-6)
     probs = jax.nn.softmax(scaled)
-    sorted_probs = jnp.sort(probs)[::-1]
-    cum = jnp.cumsum(sorted_probs)
-    cutoff_count = jnp.sum(cum - sorted_probs < topp)
-    threshold = sorted_probs[jnp.maximum(cutoff_count - 1, 0)]
+    threshold = _topp_threshold(probs, topp)
     use_topp = (topp > 0.0) & (topp < 1.0)
     filtered = jnp.where(use_topp & (probs < threshold), -jnp.inf, scaled)
     sampled = jax.random.categorical(key, filtered).astype(jnp.int32)

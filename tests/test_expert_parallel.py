@@ -246,7 +246,6 @@ class TestExpertParallelEngine:
         want = dense.prefill(prompt)
         ep_engine = InferenceEngine(path, dtype=jnp.float32, ep=2, cache_dtype="i8")
         got = ep_engine.prefill(prompt)
-        import jax.numpy as _jnp
-        assert ep_engine.cache[0][0].data.dtype == _jnp.int8
+        assert ep_engine.cache[0][0].data.dtype == jnp.int8
         scale = np.abs(want).max()
         assert np.abs(got - want).max() / scale < 0.05  # i8 cache noise bound
