@@ -16,12 +16,28 @@ CPU node — the same 1-device slot in the reference's scaling table.
 
 import json
 import sys
-import time
 
 import numpy as np
 
+from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.telemetry import Stopwatch
+
 
 BASELINE_TPS = 1000.0 / 101.81  # Llama 2 7B, 1× GCP c3d-highcpu-30 (README.md:131)
+
+
+def bench_metric(name: str, value: float, unit: str = "") -> float:
+    """Record one bench measurement as a registry gauge and read it back.
+
+    The returned value — the one that lands in BENCH_*.json — IS the
+    registry value, so the JSON report and live telemetry
+    (`python -m distributed_llama_tpu.telemetry.dump`) come from one code
+    path instead of bench keeping a private stats stash (ISSUE 1)."""
+    g = telemetry.REGISTRY.gauge(
+        f"dllama_bench_{name}", f"bench.py measurement{f' ({unit})' if unit else ''}"
+    )
+    g.set(value)
+    return g.value
 
 
 def llama2_7b_config(seq_len: int):
@@ -210,26 +226,28 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     np.asarray(jnp.zeros(4) + 1)
     rt_samples = []
     for _ in range(5):
-        t0 = time.perf_counter()
+        sw = Stopwatch()
         np.asarray(jnp.zeros(4) + 1)
-        rt_samples.append((time.perf_counter() - t0) * 1000.0)
+        rt_samples.append(sw.elapsed_ms())
     rt_ms = sorted(rt_samples)[2]
 
-    t0 = time.perf_counter()
-    logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(0))
-    np.asarray(logits[-1])  # fetch ONE row: the serving pattern (engine.prefill);
-    # a full [64, 32k] f32 fetch costs ~2 s through the remote tunnel
-    prefill_ms = (time.perf_counter() - t0) * 1000.0  # COLD: includes XLA compile
+    with telemetry.trace_span("bench_prefill_cold", tokens=prefill_len):
+        sw = Stopwatch()
+        logits, cache = fwd(cfg, params, prompt, cache, jnp.int32(0))
+        np.asarray(logits[-1])  # fetch ONE row: the serving pattern (engine.prefill);
+        # a full [64, 32k] f32 fetch costs ~2 s through the remote tunnel
+        prefill_ms = sw.elapsed_ms()  # COLD: includes XLA compile
 
     # warm prefill: same shape at a later position reuses the executable —
     # this is the steady-state serving number (round-2 verdict item #4).
     # Median of 3: single measurements jitter 2-3x on a shared/tunneled chip.
     warm_times = []
     for i in range(3):
-        t0 = time.perf_counter()
-        logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((1 + i) * prefill_len))
-        np.asarray(logits[-1])
-        warm_times.append((time.perf_counter() - t0) * 1000.0)
+        with telemetry.trace_span("bench_prefill_warm", rep=i):
+            sw = Stopwatch()
+            logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((1 + i) * prefill_len))
+            np.asarray(logits[-1])
+            warm_times.append(sw.elapsed_ms())
     prefill_warm_ms = sorted(warm_times)[1]
 
     # ON-DEVICE prefill: K chained dispatches, ONE fence, minus one round
@@ -239,12 +257,13 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     # with no intermediate fetch). Median of 3.
     K = 16
     dev_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for i in range(K):
-            logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((i % 4) * prefill_len))
-        np.asarray(logits[-1])
-        dev_times.append(((time.perf_counter() - t0) * 1000.0 - rt_ms) / K)
+    for r in range(3):
+        with telemetry.trace_span("bench_prefill_device", rep=r):
+            sw = Stopwatch()
+            for i in range(K):
+                logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((i % 4) * prefill_len))
+            np.asarray(logits[-1])
+            dev_times.append((sw.elapsed_ms() - rt_ms) / K)
     prefill_device_ms = max(sorted(dev_times)[1], 1e-3)
     prefill_tps = prefill_len / prefill_device_ms * 1000.0
 
@@ -278,14 +297,15 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     n_chunks = 4
     single_runs, user_runs = [], []
     for rep in range(3):
-        t0 = time.perf_counter()
-        tokens, cache = decode_loop(cfg, params, token, cache, jnp.int32(single_base),
-                                    steps, 0.0, 0.9, jax.random.PRNGKey(1))
-        np.asarray(tokens)
-        single_runs.append(steps / (time.perf_counter() - t0))
+        with telemetry.trace_span("bench_decode_single", rep=rep):
+            sw = Stopwatch()
+            tokens, cache = decode_loop(cfg, params, token, cache, jnp.int32(single_base),
+                                        steps, 0.0, 0.9, jax.random.PRNGKey(1))
+            np.asarray(tokens)
+            single_runs.append(steps / sw.elapsed_s())
 
         pos = chunk_base
-        t0 = time.perf_counter()
+        sw = Stopwatch()
         for _ in range(n_chunks):
             # pipelined like engine.generate_chunks: dispatch the next chunk
             # off the device-resident last token, start the previous chunk's
@@ -300,7 +320,7 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
             toks = nxt
             pos += chunk
         np.asarray(toks)  # the last dispatched chunk must finish in-window
-        user_runs.append(n_chunks * chunk / (time.perf_counter() - t0))
+        user_runs.append(n_chunks * chunk / sw.elapsed_s())
     tps = sorted(single_runs)[1]
     user_tps = sorted(user_runs)[1]
 
@@ -311,27 +331,40 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     logits, cache = fwd(cfg, params, jnp.asarray([tok], jnp.int32), cache, jnp.int32(pos))
     tok = int(np.argmax(np.asarray(logits[0])))
     pos += 1
-    t0 = time.perf_counter()
-    for _ in range(16):
-        logits, cache = fwd(cfg, params, jnp.asarray([tok], jnp.int32), cache, jnp.int32(pos))
-        tok = int(np.argmax(np.asarray(logits[0])))
-        pos += 1
-    host_tps = 16 / (time.perf_counter() - t0)
+    with telemetry.trace_span("bench_decode_host_stepwise"):
+        sw = Stopwatch()
+        for _ in range(16):
+            logits, cache = fwd(cfg, params, jnp.asarray([tok], jnp.int32), cache, jnp.int32(pos))
+            tok = int(np.argmax(np.asarray(logits[0])))
+            pos += 1
+        host_tps = 16 / sw.elapsed_s()
 
+    # every reported number passes through the telemetry registry
+    # (bench_metric): the JSON below and a live scrape see the same values
     return {
         "metric": f"{name}_{weights}_decode_tokens_per_sec_1chip",
-        "value": round(tps, 2),
+        "value": round(bench_metric("decode_tokens_per_sec", tps, "tokens/sec"), 2),
         "unit": "tokens/sec",
-        "vs_baseline": round(tps / BASELINE_TPS, 2),
+        "vs_baseline": round(bench_metric("vs_baseline", tps / BASELINE_TPS), 2),
         "detail": {
-            "ms_per_token": round(1000.0 / tps, 2),
-            "chunked_decode_tokens_per_sec": round(user_tps, 2),  # the CLI/API fast path
-            "host_sampled_tokens_per_sec": round(host_tps, 2),
-            "prefill_ms_64_tokens_cold": round(prefill_ms, 1),  # includes XLA compile
-            "prefill_ms_64_tokens_warm": round(prefill_warm_ms, 1),  # 1 dispatch + 1 tunnel RT
-            "prefill_ms_64_tokens_device": round(prefill_device_ms, 1),  # on-device, RT subtracted
-            "prefill_tokens_per_sec": round(prefill_tps, 1),
-            "tunnel_round_trip_ms": round(rt_ms, 1),
+            "ms_per_token": round(bench_metric("decode_ms_per_token", 1000.0 / tps, "ms"), 2),
+            # the CLI/API fast path
+            "chunked_decode_tokens_per_sec": round(
+                bench_metric("chunked_decode_tokens_per_sec", user_tps, "tokens/sec"), 2),
+            "host_sampled_tokens_per_sec": round(
+                bench_metric("host_sampled_tokens_per_sec", host_tps, "tokens/sec"), 2),
+            # cold includes XLA compile; warm = 1 dispatch + 1 tunnel RT
+            "prefill_ms_64_tokens_cold": round(
+                bench_metric("prefill_cold_ms", prefill_ms, "ms"), 1),
+            "prefill_ms_64_tokens_warm": round(
+                bench_metric("prefill_warm_ms", prefill_warm_ms, "ms"), 1),
+            # on-device, RT subtracted
+            "prefill_ms_64_tokens_device": round(
+                bench_metric("prefill_device_ms", prefill_device_ms, "ms"), 1),
+            "prefill_tokens_per_sec": round(
+                bench_metric("prefill_tokens_per_sec", prefill_tps, "tokens/sec"), 1),
+            "tunnel_round_trip_ms": round(
+                bench_metric("tunnel_round_trip_ms", rt_ms, "ms"), 1),
             "baseline": "Llama 2 7B 101.81 ms/token, 1x GCP c3d-highcpu-30 (reference README.md:131)",
             "device": None,
         },
@@ -413,6 +446,9 @@ if __name__ == "__main__":
     from distributed_llama_tpu.platform import enable_compilation_cache
 
     enable_compilation_cache()
+    # the bench IS an observability consumer: its numbers flow through the
+    # telemetry registry (bench_metric) and its phases record trace spans
+    telemetry.enable()
     if "--q40-only" in sys.argv:
         main_single("q40")
     elif "--bf16-only" in sys.argv:
@@ -424,3 +460,9 @@ if __name__ == "__main__":
         print(json.dumps(run(mixtral_shaped_config(1024), "mixtral_shaped_moe", weights="q40")))
     else:
         main()
+    import os
+
+    trace_path = os.environ.get("DLLAMA_BENCH_TRACE")
+    if trace_path:  # phase spans as Chrome trace JSON (docs/OBSERVABILITY.md)
+        telemetry.export_chrome_trace(trace_path)
+        sys.stderr.write(f"bench trace written to {trace_path}\n")

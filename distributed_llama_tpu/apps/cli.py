@@ -23,6 +23,7 @@ import time
 
 import numpy as np
 
+from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.tokenizer import (
     ChatItem,
     ChatTemplate,
@@ -100,6 +101,19 @@ def build_parser() -> argparse.ArgumentParser:
         "of bf16 — the TPU-native replacement for the reference's "
         "disc-backed --kv-cache-storage (longer contexts in the same memory)",
     )
+    p.add_argument(
+        "--telemetry", action="store_true", default=False,
+        help="enable the telemetry subsystem: metrics registry (served at "
+        "GET /metrics by dllama-tpu-api) + span tracer (Chrome trace JSON "
+        "written to --trace-out after a generate/inference run). "
+        "DLLAMA_TELEMETRY=1 in the environment enables it too; off by "
+        "default — disabled instruments are no-ops on the decode hot path",
+    )
+    p.add_argument(
+        "--trace-out", default="dllama-trace.json", metavar="PATH",
+        help="where a --telemetry generate/inference run writes its Chrome "
+        "trace-event JSON (open in chrome://tracing or ui.perfetto.dev)",
+    )
     # accepted-for-parity flags (see module docstring)
     p.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
@@ -173,7 +187,7 @@ def generate(args, benchmark: bool) -> None:
     if n_prompt < 1:
         raise SystemExit("Expected at least 1 prompt token")
 
-    total_start = time.perf_counter()
+    total_sw = Stopwatch()
     if args.decode == "device":
         # prefill→decode fusion: the first token is sampled on device and the
         # first decode chunk is dispatched before anything is fetched — one
@@ -253,7 +267,7 @@ def generate(args, benchmark: bool) -> None:
                 token = next_token
 
     avg = engine.avg_stats()
-    total_ms = (time.perf_counter() - total_start) * 1000.0
+    total_ms = total_sw.elapsed_ms()
     n = max(1, engine.total_tokens())
     _print("\n")
     _print(f"Generated tokens:    {generated}\n")
@@ -393,6 +407,11 @@ def main(argv=None) -> None:
     reassert_jax_platforms()
     enable_compilation_cache()
     args = build_parser().parse_args(argv)
+    from distributed_llama_tpu import telemetry
+
+    # must happen BEFORE make_engine: instruments bind at construction
+    if args.telemetry:
+        telemetry.enable()
     if args.mode == "inference":
         generate(args, benchmark=True)
     elif args.mode == "generate":
@@ -401,6 +420,9 @@ def main(argv=None) -> None:
         chat(args)
     elif args.mode == "worker":
         worker(args)
+    if telemetry.is_enabled() and args.mode in ("inference", "generate"):
+        path = telemetry.export_chrome_trace(args.trace_out)
+        _print(f"📊 telemetry: Chrome trace written to {path}\n")
 
 
 if __name__ == "__main__":

@@ -29,13 +29,14 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_llama_tpu import telemetry
 from distributed_llama_tpu.engine import weights as weights_lib
+from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 
@@ -83,10 +84,31 @@ class EngineStream:
         # the engine's pipeline depth up (released at the first-token fetch)
         self._depth_held = False
         engine._streams.append(self)
+        engine._tel.active_streams.set(len(engine._streams))
 
     @property
     def cfg(self) -> LlamaConfig:
         return self.engine.cfg
+
+    # ------------------------------------------------------------------
+    # Telemetry feeds (no-ops unless telemetry was enabled when the engine
+    # was constructed; tel.enabled guards keep the disabled path to one
+    # attribute check per DISPATCH — never per token, no registry access)
+    # ------------------------------------------------------------------
+
+    def _note_prefill(self, entry: "TokenStats") -> None:
+        tel = self.engine._tel
+        if tel.enabled:
+            tel.prompt_tokens.inc(entry.n_tokens)
+            tel.prefill_latency.observe(entry.generation_ms / 1000.0)
+            tel.kv_occupancy.set(self.pos / self.engine.cfg.seq_len)
+
+    def _note_decode(self, n_tokens: int, per_token_ms: float) -> None:
+        tel = self.engine._tel
+        if tel.enabled:
+            tel.tokens_generated.inc(n_tokens)
+            tel.decode_latency.observe(per_token_ms / 1000.0)
+            tel.kv_occupancy.set(self.pos / self.engine.cfg.seq_len)
 
     # ------------------------------------------------------------------
     # Generation API
@@ -147,14 +169,17 @@ class EngineStream:
         self._release_depth()
         tokens = np.asarray(tokens, dtype=np.int32)
         n = tokens.shape[0]
-        start = time.perf_counter()
-        logits = np.asarray(self._forward_device(tokens)[:n])
-        elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(
-            self.engine._split_stats(
-                elapsed, n_tokens=n, n_dispatches=self.engine._last_dispatches()
-            )
+        sw = Stopwatch()
+        with self.engine._tel.span("forward", tokens=n, pos=self.pos):
+            logits = np.asarray(self._forward_device(tokens)[:n])
+        entry = self.engine._split_stats(
+            sw.elapsed_ms(), n_tokens=n, n_dispatches=self.engine._last_dispatches()
         )
+        self.stats.append(entry)
+        if n > 1:
+            self._note_prefill(entry)
+        else:
+            self._note_decode(1, entry.generation_ms)
         return logits
 
     def prefill(self, tokens: list[int]) -> np.ndarray:
@@ -167,14 +192,14 @@ class EngineStream:
         self._release_depth()  # see forward()
         tokens = np.asarray(tokens, dtype=np.int32)
         n = tokens.shape[0]
-        start = time.perf_counter()
-        logits = np.asarray(self._forward_device(tokens)[n - 1])
-        elapsed = (time.perf_counter() - start) * 1000.0
-        self.stats.append(
-            self.engine._split_stats(
-                elapsed, n_tokens=n, n_dispatches=self.engine._last_dispatches()
-            )
+        sw = Stopwatch()
+        with self.engine._tel.span("prefill", tokens=n, pos=self.pos):
+            logits = np.asarray(self._forward_device(tokens)[n - 1])
+        entry = self.engine._split_stats(
+            sw.elapsed_ms(), n_tokens=n, n_dispatches=self.engine._last_dispatches()
         )
+        self.stats.append(entry)
+        self._note_prefill(entry)
         return logits
 
     def prefill_device(self, tokens: list[int], temperature, topp, seed: int):
@@ -196,7 +221,7 @@ class EngineStream:
         engine = self.engine
         tokens = np.asarray(tokens, dtype=np.int32)
         n = tokens.shape[0]
-        start = time.perf_counter()
+        sw = Stopwatch()
         # the dispatches below are never fetched here: mark the engine
         # non-quiescent so the transfer probe does not queue behind them and
         # time their compute (see _transfer_ms_per_token). The depth stays
@@ -205,18 +230,23 @@ class EngineStream:
         # the whole prefill-to-first-fetch span.
         self._hold_depth()
         try:
-            logits = self._forward_device(tokens)
-            key = jax.random.PRNGKey(seed)
-            key, sub = jax.random.split(key)
-            token = engine._sample_row(
-                logits, jnp.int32(n - 1), sub, jnp.float32(temperature), jnp.float32(topp)
-            )
-            elapsed = (time.perf_counter() - start) * 1000.0
+            with engine._tel.span("prefill_dispatch", tokens=n, pos=self.pos):
+                logits = self._forward_device(tokens)
+                key = jax.random.PRNGKey(seed)
+                key, sub = jax.random.split(key)
+                token = engine._sample_row(
+                    logits, jnp.int32(n - 1), sub, jnp.float32(temperature), jnp.float32(topp)
+                )
             entry = engine._split_stats(
-                elapsed, n_tokens=n, n_dispatches=engine._last_dispatches()
+                sw.elapsed_ms(), n_tokens=n, n_dispatches=engine._last_dispatches()
             )
             self.stats.append(entry)
             self._pending_prefill_entry = entry
+            # prompt tokens count now; the prefill LATENCY observation waits
+            # for _fetch_fused_first, where the entry gains its true
+            # device-compute drain time
+            if engine._tel.enabled:
+                engine._tel.prompt_tokens.inc(n)
         except BaseException:
             self._release_depth()
             raise
@@ -261,7 +291,7 @@ class EngineStream:
             raise ValueError(f"context overflow: pos {self.pos} + {n_steps}")
         from distributed_llama_tpu.models import sampling
 
-        start = time.perf_counter()
+        sw = Stopwatch()
         if engine._tp_engine is not None:
             tokens, self.cache = engine._tp_engine.decode_loop(
                 engine.params,
@@ -286,9 +316,10 @@ class EngineStream:
                 jax.random.PRNGKey(seed),
             )
         tokens = np.asarray(tokens)
-        elapsed_ms = (time.perf_counter() - start) * 1000.0
-        self.stats.extend([engine._split_stats(elapsed_ms / n_steps)] * n_steps)
+        per_token_ms = sw.elapsed_ms() / n_steps
+        self.stats.extend([engine._split_stats(per_token_ms)] * n_steps)
         self.pos += n_steps
+        self._note_decode(n_steps, per_token_ms)
         return tokens
 
     def _dispatch_chunk(self, first_token, n_steps: int, temperature, topp, key):
@@ -299,17 +330,18 @@ class EngineStream:
         from distributed_llama_tpu.models import sampling
 
         engine = self.engine
-        if engine._tp_engine is not None:
-            tokens, self.cache, key = engine._tp_engine.decode_chunk(
-                engine.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
-                n_steps, temperature, topp, key,
-            )
-        else:
-            tokens, self.cache, key = sampling.decode_chunk(
-                engine.cfg, engine.params, jnp.int32(first_token), self.cache,
-                jnp.int32(self.pos), n_steps, jnp.float32(temperature),
-                jnp.float32(topp), key,
-            )
+        with engine._tel.span("decode_chunk_dispatch", pos=self.pos, steps=n_steps):
+            if engine._tp_engine is not None:
+                tokens, self.cache, key = engine._tp_engine.decode_chunk(
+                    engine.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
+                    n_steps, temperature, topp, key,
+                )
+            else:
+                tokens, self.cache, key = sampling.decode_chunk(
+                    engine.cfg, engine.params, jnp.int32(first_token), self.cache,
+                    jnp.int32(self.pos), n_steps, jnp.float32(temperature),
+                    jnp.float32(topp), key,
+                )
         self.pos += n_steps
         return tokens, key
 
@@ -317,11 +349,12 @@ class EngineStream:
         """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
         temperature/topp (no recompile when a request changes them). Returns
         (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
-        start = time.perf_counter()
+        sw = Stopwatch()
         tokens, key = self._dispatch_chunk(first_token, n_steps, temperature, topp, key)
         tokens = np.asarray(tokens)
-        elapsed_ms = (time.perf_counter() - start) * 1000.0
-        self.stats.extend([self.engine._split_stats(elapsed_ms / n_steps)] * n_steps)
+        per_token_ms = sw.elapsed_ms() / n_steps
+        self.stats.extend([self.engine._split_stats(per_token_ms)] * n_steps)
+        self._note_decode(n_steps, per_token_ms)
         return tokens, key
 
     def generate_chunks(
@@ -413,15 +446,26 @@ class EngineStream:
         and the prefill compute would be misattributed to the first chunk).
         Also releases the depth hold prefill_device took: the prefill is
         drained now, so the probe-quiescence hazard it guarded is gone."""
-        start = time.perf_counter()
-        tok = int(np.asarray(first_token))
+        sw = Stopwatch()
+        with self.engine._tel.span("first_token_fetch"):
+            tok = int(np.asarray(first_token))
         self._release_depth()
-        drained_ms = (time.perf_counter() - start) * 1000.0
+        drained_ms = sw.elapsed_ms()
         entry = self._pending_prefill_entry
         if entry is not None:
             entry.generation_ms += drained_ms
             entry.inference_ms += drained_ms
             self._pending_prefill_entry = None
+            # the deferred prefill-latency observation (see prefill_device):
+            # the entry now carries dispatch + device-compute drain time.
+            # The fused first token counts as GENERATED here — it belongs to
+            # no decode chunk (generate_chunks consumes it, never yields it
+            # from a chunk), and its latency is folded into the prefill entry
+            tel = self.engine._tel
+            if tel.enabled:
+                tel.prefill_latency.observe(entry.generation_ms / 1000.0)
+                tel.tokens_generated.inc(1)
+                tel.kv_occupancy.set(self.pos / self.engine.cfg.seq_len)
         return tok
 
     def _generate_chunks_pipelined(
@@ -431,7 +475,7 @@ class EngineStream:
         while True:
             # the timed window covers dispatch+fetch only — consumer time
             # between yields must not be attributed to the engine's stats
-            start = time.perf_counter()
+            sw = Stopwatch()
             # speculatively dispatch the next chunk off the device-resident
             # last token before fetching the pending one
             if self.pos < stop:
@@ -439,16 +483,18 @@ class EngineStream:
                 nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
             else:
                 nxt, k = None, 0
-            try:
-                # start the device->host copy without blocking: behind a
-                # remote PJRT tunnel the blocking fetch pays a full round
-                # trip; enqueued here it overlaps the next chunk's compute
-                pending.copy_to_host_async()
-            except Exception:
-                pass  # optional acceleration; np.asarray below is the contract
-            toks = np.asarray(pending)
-            elapsed_ms = (time.perf_counter() - start) * 1000.0
-            self.stats.extend([engine._split_stats(elapsed_ms / pending_n)] * pending_n)
+            with engine._tel.span("decode_chunk_fetch", tokens=pending_n):
+                try:
+                    # start the device->host copy without blocking: behind a
+                    # remote PJRT tunnel the blocking fetch pays a full round
+                    # trip; enqueued here it overlaps the next chunk's compute
+                    pending.copy_to_host_async()
+                except Exception:
+                    pass  # optional acceleration; np.asarray below is the contract
+                toks = np.asarray(pending)
+            per_token_ms = sw.elapsed_ms() / pending_n
+            self.stats.extend([engine._split_stats(per_token_ms)] * pending_n)
+            self._note_decode(pending_n, per_token_ms)
             for t in toks.tolist():
                 yield int(t)
             if nxt is None:
@@ -561,6 +607,11 @@ class InferenceEngine:
         self.tp = tp
         self.sp = sp
         self.ep = ep
+        # instrument bundle bound ONCE per engine: real registry-backed
+        # instruments when telemetry is enabled at construction, shared
+        # no-op singletons otherwise (the zero-overhead-when-disabled
+        # contract — hot paths hold attributes, never do registry lookups)
+        self._tel = telemetry.EngineInstruments()
         if ep > 1 and sp > 1:
             raise ValueError("--ep and --sp do not compose (pick one FFN/context strategy)")
         # the parallel backend is constructed BEFORE the weights load so the
@@ -692,6 +743,9 @@ class InferenceEngine:
 
     def decode_step(self, token: int) -> np.ndarray:
         return self._default.decode_step(token)
+
+    def fetch_first_token(self, first_token) -> int:
+        return self._default.fetch_first_token(first_token)
 
     def generate_on_device(self, *args, **kwargs) -> np.ndarray:
         return self._default.generate_on_device(*args, **kwargs)

@@ -453,6 +453,19 @@ class SequenceParallelForward(TransferProbeMixin):
         z = jnp.ones((1, cfg.dim), jnp.float32)
         return jax.jit(mapped), (m, o, z)
 
+    def transfer_bytes_per_token(self) -> int:
+        """The probed sp decode sequence per layer: pmax + psum of the
+        online-softmax max/normalizer partials ([1, K, M] each) and a psum
+        of the output partial ([1, K, M, hd]) over sp, plus the two full
+        [1, dim] tp all-reduces on a 2-D mesh (see :meth:`transfer_probe`)."""
+        cfg = self.cfg
+        K = cfg.n_kv_heads // self.tp
+        M = max(1, (cfg.n_heads // self.tp) // max(K, 1))
+        per_layer = (2 * K * M + K * M * cfg.head_size) * 4
+        if self._tp_axis is not None:
+            per_layer += 2 * cfg.dim * 4
+        return cfg.n_layers * per_layer
+
 
 def _sp_logits(cfg, tp_axis, params, x):
     """Final logits with the optional tp vocab-shard all-gather."""

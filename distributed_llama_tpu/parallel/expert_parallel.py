@@ -482,6 +482,15 @@ class ExpertParallelForward(TransferProbeMixin):
         z = jnp.ones((1, cfg.dim), jnp.float32)
         return jax.jit(mapped), (x, z)
 
+    def transfer_bytes_per_token(self) -> int:
+        """The probed EP decode sequence per layer: one ep-psum of the
+        [1, dim] expert-partition partial, plus the two [1, dim] tp
+        all-reduces when composed with TP (see :meth:`transfer_probe`)."""
+        per_layer = self.cfg.dim * 4
+        if self._tp_axis is not None:
+            per_layer += 2 * self.cfg.dim * 4
+        return self.cfg.n_layers * per_layer
+
 
 def _ep_forward(cfg, tp_axis, params, tokens, cache, pos):
     """Per-shard forward body on the (tp, ep) mesh: the shared llama wiring

@@ -200,9 +200,26 @@ def place_params(host_params, specs, mesh) -> Any:
 
 
 class TransferProbeMixin:
-    """Shared timing harness over a backend's :meth:`transfer_probe`: both
+    """Shared timing harness over a backend's :meth:`transfer_probe`: all
     parallel backends measure their collective ("transfer") cost the same
-    way, so the methodology lives once."""
+    way, so the methodology lives once. Each measurement also feeds the
+    telemetry registry (all-reduce latency histogram + estimated payload
+    bytes) when telemetry is enabled."""
+
+    def _collective_tel(self):
+        tel = getattr(self, "_collective_tel_bundle", None)
+        if tel is None:
+            from distributed_llama_tpu import telemetry as _telemetry
+
+            tel = _telemetry.CollectiveInstruments()
+            self._collective_tel_bundle = tel
+        return tel
+
+    def transfer_bytes_per_token(self) -> int:
+        """Estimated LOGICAL payload bytes the probed collective sequence
+        moves per token (f32 activations; backends override with their own
+        per-layer collective shapes). 0 when a backend declines to estimate."""
+        return 0
 
     def measure_transfer_ms(self, n_tokens: int = 32) -> float:
         """Per-token collective cost on the real mesh, replayed
@@ -211,15 +228,21 @@ class TransferProbeMixin:
         quiescent points, so the printed T follows actual interconnect load
         over a session — the TPU analogue of the reference's
         TASK_TYPE_TRANSFER wall-time accounting (src/utils.cpp:216-218)."""
-        import time as _time
+        from distributed_llama_tpu.telemetry import Stopwatch
 
+        tel = self._collective_tel()
         jitted, args = self._transfer_probe_cached(n_tokens)
-        t0 = _time.perf_counter()
-        # fetch, don't block_until_ready: through a remote PJRT tunnel the
-        # latter returns before execution finishes (docs/PERF.md)
-        np.asarray(jitted(*args)[0])
-        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
-        return elapsed_ms / n_tokens
+        with tel.span("transfer_probe", tokens=n_tokens):
+            sw = Stopwatch()
+            # fetch, don't block_until_ready: through a remote PJRT tunnel the
+            # latter returns before execution finishes (docs/PERF.md)
+            np.asarray(jitted(*args)[0])
+            per_token_ms = sw.elapsed_ms() / n_tokens
+        if tel.enabled:
+            tel.probe_runs.inc()
+            tel.allreduce_latency.observe(per_token_ms / 1000.0)
+            tel.allreduce_bytes.inc(self.transfer_bytes_per_token() * n_tokens)
+        return per_token_ms
 
     def _transfer_probe_cached(self, n_tokens: int):
         key = ("probe", n_tokens)
@@ -500,6 +523,15 @@ class TensorParallelForward(TransferProbeMixin):
         x = jnp.ones((1, cfg.dim), jnp.float32)
         lg = jnp.ones((1, vshard * self.tp if shard_vocab else cfg.vocab_size), jnp.float32)
         return jax.jit(mapped), (x, lg)
+
+    def transfer_bytes_per_token(self) -> int:
+        """2 psums of a [1, dim] f32 activation per layer (after wo and
+        after down) plus the vocab all-gather when wcls is sharded — the
+        exact sequence :meth:`transfer_probe` replays."""
+        n = 2 * self.cfg.n_layers * self.cfg.dim * 4
+        if self.shard_vocab:
+            n += self.cfg.vocab_size * 4
+        return n
 
     def init_cache(self, dtype=jnp.float32):
         from distributed_llama_tpu.ops import kv_cache as kvc

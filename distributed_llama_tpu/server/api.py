@@ -38,8 +38,11 @@ import dataclasses
 import json
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.tokenizer import (
     ChatItem,
     ChatTemplate,
@@ -53,6 +56,13 @@ from distributed_llama_tpu.tokenizer import (
 )
 
 MODEL_NAME = "Distributed Model"  # (reference: types.hpp:54, 80)
+
+
+def new_request_id() -> str:
+    """Request correlation id: threaded through response ids, error bodies,
+    the X-Request-Id header, and server logs (the reference's responses are
+    anonymous — a fixed "cmpl-j0" for every request, types.hpp:58)."""
+    return uuid.uuid4().hex[:16]
 
 
 class BadRequest(ValueError):
@@ -142,12 +152,18 @@ class ApiState:
         self.cache = self.slots[0].cache  # single-stream tests poke this
         self._mutex = threading.Lock()
         self._free = threading.Semaphore(n)
+        # server instrument bundle (requests / duration / in-flight / queue
+        # wait): real registry metrics when telemetry is enabled at startup,
+        # shared no-op singletons otherwise
+        self.tel = telemetry.ServerInstruments()
 
     def _acquire_slot(self, messages: list[dict]) -> StreamSlot:
         """Block until a lane is free, then take the free lane whose chat
         prefix cache reuses the most of this request (prefix affinity keeps
         multi-turn KV reuse working under concurrency)."""
+        sw = Stopwatch()
         self._free.acquire()
+        self.tel.queue_wait.observe(sw.elapsed_s())
         with self._mutex:
             free = [s for s in self.slots if not s.busy]
             # primary: longest prefix reuse; tie-break: prefer an EMPTY
@@ -165,22 +181,31 @@ class ApiState:
             slot.busy = False
         self._free.release()
 
-    def complete(self, body: dict, send_chunk, params: dict | None = None) -> dict | None:
+    def complete(
+        self, body: dict, send_chunk, params: dict | None = None,
+        request_id: str | None = None,
+    ) -> dict | None:
         """Run one completion. ``send_chunk(str)`` streams SSE data lines when
         the request has stream=true (then returns None); otherwise returns the
         final JSON payload. Up to ``--parallel`` calls run concurrently, each
         on its own stream; excess calls queue.
         ``params``: the pre-validated result of :meth:`_parse` (the handler
-        validates before sending SSE headers, so validation runs once)."""
+        validates before sending SSE headers, so validation runs once).
+        ``request_id``: correlation id threaded into response ids (one is
+        generated when the caller has none)."""
         if params is None:
             params = self._parse(body)
+        if request_id is None:
+            request_id = new_request_id()
         slot = self._acquire_slot(params["messages"])
         try:
-            return self._complete_on(slot, params, send_chunk)
+            return self._complete_on(slot, params, send_chunk, request_id)
         finally:
             self._release_slot(slot)
 
-    def _complete_on(self, slot: StreamSlot, params: dict, send_chunk) -> dict | None:
+    def _complete_on(
+        self, slot: StreamSlot, params: dict, send_chunk, request_id: str
+    ) -> dict | None:
         engine, tokenizer = slot.stream, self.tokenizer
         stream = params["stream"]
 
@@ -258,7 +283,7 @@ class ApiState:
                     text = delta.decode("utf-8", errors="replace")
                     buffer.append(text)
                     if stream:
-                        send_chunk(self._chunk_json(text, stop=False))
+                        send_chunk(self._chunk_json(text, stop=False, request_id=request_id))
                 detector.clear()
             return res
 
@@ -312,7 +337,7 @@ class ApiState:
                 text = tail.decode("utf-8", errors="replace")
                 buffer.append(text)
                 if stream:
-                    send_chunk(self._chunk_json(text, stop=False))
+                    send_chunk(self._chunk_json(text, stop=False, request_id=request_id))
 
         content = "".join(buffer)
         if engine.pos >= seq_len:
@@ -322,12 +347,13 @@ class ApiState:
 
         if stream:
             send_chunk(
-                self._chunk_json("", stop=True, finish_reason=finish_reason, warning=warning)
+                self._chunk_json("", stop=True, finish_reason=finish_reason,
+                                 warning=warning, request_id=request_id)
             )
             send_chunk("[DONE]")
             return None
         result = {
-            "id": "cmpl-j0",
+            "id": f"chatcmpl-{request_id}",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": MODEL_NAME,
@@ -350,7 +376,7 @@ class ApiState:
 
     def _chunk_json(
         self, delta_text: str, stop: bool, finish_reason: str = "stop",
-        warning: str | None = None,
+        warning: str | None = None, request_id: str = "0",
     ) -> str:
         choice: dict = {"index": 0, "finish_reason": finish_reason if stop else ""}
         choice["delta"] = (
@@ -359,7 +385,7 @@ class ApiState:
             else {"role": "assistant", "content": delta_text}
         )
         payload = {
-            "id": "cmpl-c0",
+            "id": f"chatcmpl-{request_id}",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": MODEL_NAME,
@@ -438,68 +464,125 @@ def make_handler(state: ApiState):
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+                state.tel.requests.labels(route="/v1/models", status="200").inc()
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the process-global registry
+                # (engine + server + collective instruments). Valid, possibly
+                # sparse, output even when telemetry is disabled — scrapers
+                # should not get a 404 from a healthy server.
+                payload = telemetry.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                state.tel.requests.labels(route="/metrics", status="200").inc()
             else:
                 self.send_error(404)
+                state.tel.requests.labels(route="other", status="404").inc()
 
-        def _send_json(self, status: int, payload: dict) -> None:
+        def _send_json(
+            self, status: int, payload: dict, request_id: str | None = None
+        ) -> None:
             data = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if request_id is not None:
+                self.send_header("X-Request-Id", request_id)
             self.end_headers()
             self.wfile.write(data)
 
+        def _error_body(self, message: str, err_type: str, request_id: str) -> dict:
+            return {
+                "error": {
+                    "message": message,
+                    "type": err_type,
+                    "request_id": request_id,
+                }
+            }
+
         def do_POST(self):
+            # request-duration measurement uses a MONOTONIC clock (Stopwatch
+            # wraps perf_counter: a wall-clock step mid-request — NTP, DST —
+            # must not corrupt the duration histogram), and every response
+            # carries a correlation id so client-reported failures can be
+            # matched to server logs
+            rid = new_request_id()
+            sw = Stopwatch()
+            tel = state.tel
+            status = "500"
+            tel.inflight.inc()
+            try:
+                status = self._do_post_inner(rid)
+            finally:
+                tel.inflight.dec()
+                tel.request_duration.observe(sw.elapsed_s())
+                route = (
+                    "/v1/chat/completions"
+                    if self.path == "/v1/chat/completions" else "other"
+                )
+                tel.requests.labels(route=route, status=status).inc()
+
+        def _do_post_inner(self, rid: str) -> str:
+            """Handle one POST; returns the response status for metrics."""
             if self.path != "/v1/chat/completions":
                 self.send_error(404)
-                return
+                return "404"
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length) or b"{}"
             try:
                 body = json.loads(raw)
             except json.JSONDecodeError as e:
                 self._send_json(
-                    400, {"error": {"message": f"malformed JSON: {e}", "type": "invalid_request_error"}}
+                    400,
+                    self._error_body(f"malformed JSON: {e}", "invalid_request_error", rid),
+                    request_id=rid,
                 )
-                return
+                return "400"
             try:
                 # validate BEFORE any SSE headers go out: a 400 must be a
                 # clean HTTP error, not a broken event stream
                 params = state._parse(body)
             except BadRequest as e:
                 self._send_json(
-                    400, {"error": {"message": str(e), "type": "invalid_request_error"}}
+                    400, self._error_body(str(e), "invalid_request_error", rid),
+                    request_id=rid,
                 )
-                return
+                return "400"
             try:
                 if body.get("stream"):
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
                     self.send_header("Connection", "close")
+                    self.send_header("X-Request-Id", rid)
                     self.end_headers()
 
                     def send_chunk(data: str):
                         self.wfile.write(f"data: {data}\r\n\r\n".encode())
                         self.wfile.flush()
 
-                    state.complete(body, send_chunk, params=params)
+                    state.complete(body, send_chunk, params=params, request_id=rid)
                     self.close_connection = True
                 else:
-                    result = state.complete(body, lambda s: None, params=params)
-                    self._send_json(200, result)
+                    result = state.complete(
+                        body, lambda s: None, params=params, request_id=rid
+                    )
+                    self._send_json(200, result, request_id=rid)
+                return "200"
             except BrokenPipeError:
-                pass  # client went away mid-stream
+                return "499"  # client went away mid-stream
             except Exception as e:  # engine failure: surface it, keep serving
-                print(f"🛑 request failed: {type(e).__name__}: {e}")
+                print(f"🛑 request {rid} failed: {type(e).__name__}: {e}")
                 if body.get("stream"):
                     # SSE headers are already out — emit a terminal error
                     # event so the client sees the failure, not a silent
                     # truncation
                     try:
-                        err = json.dumps(
-                            {"error": {"message": str(e), "type": "server_error"}}
-                        )
+                        err = json.dumps(self._error_body(str(e), "server_error", rid))
                         self.wfile.write(f"data: {err}\r\n\r\ndata: [DONE]\r\n\r\n".encode())
                         self.wfile.flush()
                     except OSError:
@@ -507,8 +590,10 @@ def make_handler(state: ApiState):
                     self.close_connection = True
                 else:
                     self._send_json(
-                        500, {"error": {"message": str(e), "type": "server_error"}}
+                        500, self._error_body(str(e), "server_error", rid),
+                        request_id=rid,
                     )
+                return "500"
 
     return Handler
 
@@ -516,6 +601,10 @@ def make_handler(state: ApiState):
 def serve(args) -> None:
     from distributed_llama_tpu.apps.cli import make_engine
 
+    # --telemetry / DLLAMA_TELEMETRY must take effect BEFORE the engine and
+    # ApiState bind their instrument bundles (bind-once contract)
+    if getattr(args, "telemetry", False):
+        telemetry.enable()
     engine, tokenizer, sampler = make_engine(args)
     state = ApiState(engine, tokenizer, sampler, args)
     # threaded HTTP front (GET /v1/models and queued POSTs stay responsive);
@@ -524,6 +613,8 @@ def serve(args) -> None:
     server = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(state))
     server.daemon_threads = True
     print(f"Server URL: http://127.0.0.1:{args.port}/v1/")
+    if telemetry.is_enabled():
+        print(f"Metrics:    http://127.0.0.1:{args.port}/metrics")
     server.serve_forever()
 
 

@@ -1,0 +1,303 @@
+"""Unified telemetry: metrics registry + span tracer + exposition (ISSUE 1).
+
+One process-global :class:`~distributed_llama_tpu.telemetry.registry.MetricsRegistry`
+and one :class:`~distributed_llama_tpu.telemetry.tracer.SpanTracer` back every
+instrument in the engine, the parallel backends, the API server, and bench.py.
+The reference engine's only observability is ad-hoc stat prints
+(reference: src/apps/dllama/dllama.cpp:49-93); this module is the shared sink.
+
+Toggling
+--------
+Telemetry is OFF by default. Enable with the ``--telemetry`` CLI flag
+(dllama-tpu / dllama-tpu-api / bench.py) or ``DLLAMA_TELEMETRY=1`` in the
+environment (read once at import). ``enable()`` / ``disable()`` switch the
+process at runtime, but instruments are BOUND at component construction:
+code binds once (engine ``__init__``, server startup) via :func:`counter` /
+:func:`gauge` / :func:`histogram` / the ``span`` factory, and gets back
+
+* the real registry-registered instrument when telemetry is enabled, or
+* a shared null singleton whose methods are no-ops when it is disabled.
+
+That bind-once contract is the zero-overhead-when-disabled design: the hot
+decode loop holds direct attribute references, pays one no-op method call
+per *dispatch* (never per token), performs no dict lookups, and never
+mutates the registry. Components constructed before ``enable()`` keep their
+null instruments — construct (or rebind) after enabling.
+
+Metric names are listed in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from distributed_llama_tpu.telemetry.registry import (  # noqa: F401  (re-export)
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from distributed_llama_tpu.telemetry.tracer import (  # noqa: F401  (re-export)
+    NULL_SPAN,
+    SpanTracer,
+)
+
+REGISTRY = MetricsRegistry()
+TRACER = SpanTracer()
+
+_ENV_VAR = "DLLAMA_TELEMETRY"
+_enabled = os.environ.get(_ENV_VAR, "").strip().lower() in ("1", "true", "on", "yes")
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Clear the registry and the span ring buffer (tests)."""
+    REGISTRY.reset()
+    TRACER.clear()
+
+
+# ----------------------------------------------------------------------
+# Null instruments: the disabled-mode bind targets. One shared stateless
+# singleton per kind — no locks, no values, no registry entry. Tradeoff:
+# .labels(...) cannot validate label NAMES here (the shared singleton
+# knows no declaration, and a per-call check would tax the disabled hot
+# path), so a labelnames typo only surfaces when telemetry is enabled —
+# every labelled call site must therefore be covered by an enabled-mode
+# test (tests/test_telemetry.py does this for all current sites).
+# ----------------------------------------------------------------------
+
+
+class _NullCounter:
+    __slots__ = ()
+    value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def labels(self, **kw):
+        return self
+
+
+class _NullGauge:
+    __slots__ = ()
+    value = 0.0
+
+    def set(self, v: float) -> None:
+        pass
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def labels(self, **kw):
+        return self
+
+
+class _NullHistogram:
+    __slots__ = ()
+    count = 0
+    sum = 0.0
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def labels(self, **kw):
+        return self
+
+
+NULL_COUNTER = _NullCounter()
+NULL_GAUGE = _NullGauge()
+NULL_HISTOGRAM = _NullHistogram()
+
+
+def counter(name: str, help: str = "", labelnames=()) -> Counter | _NullCounter:
+    if not _enabled:
+        return NULL_COUNTER
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames=()) -> Gauge | _NullGauge:
+    if not _enabled:
+        return NULL_GAUGE
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(
+    name: str, help: str = "", labelnames=(), buckets=DEFAULT_LATENCY_BUCKETS
+) -> Histogram | _NullHistogram:
+    if not _enabled:
+        return NULL_HISTOGRAM
+    return REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+def _null_span(name: str, **args):
+    return NULL_SPAN
+
+
+def _real_span(name: str, **args):
+    return TRACER.span(name, **args)
+
+
+def span_factory():
+    """The span entry point to BIND at construction time: returns either the
+    live tracer's span() or a factory handing out the shared no-op span."""
+    return _real_span if _enabled else _null_span
+
+
+def trace_span(name: str, **args):
+    """``with trace_span("decode", step=pos):`` — checks the enable flag per
+    call; hot paths should bind :func:`span_factory` once instead."""
+    return (_real_span if _enabled else _null_span)(name, **args)
+
+
+def prometheus_text() -> str:
+    return REGISTRY.prometheus_text()
+
+
+def chrome_trace() -> dict:
+    return TRACER.chrome_trace()
+
+
+def export_chrome_trace(path: str) -> str:
+    return TRACER.export_chrome_trace(path)
+
+
+# ----------------------------------------------------------------------
+# Shared wall-clock helper: the ONE copy of the perf-timing pattern that
+# engine/engine.py and parallel/tensor_parallel.py used to hand-roll.
+# ----------------------------------------------------------------------
+
+
+class Stopwatch:
+    """``sw = Stopwatch(); ...; ms = sw.elapsed_ms()`` — monotonic, restartable."""
+
+    __slots__ = ("_t0",)
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+
+    def restart(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def elapsed_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1000.0
+
+
+# ----------------------------------------------------------------------
+# Instrument bundles: each subsystem binds its instruments in one place so
+# hot code holds plain attributes (and can skip whole blocks on .enabled).
+# ----------------------------------------------------------------------
+
+
+class EngineInstruments:
+    """The engine's metric surface (bound once per InferenceEngine)."""
+
+    def __init__(self):
+        self.enabled = _enabled
+        self.span = span_factory()
+        self.tokens_generated = counter(
+            "dllama_tokens_generated_total",
+            "Decoded (generated) tokens across all engine streams",
+        )
+        self.prompt_tokens = counter(
+            "dllama_prompt_tokens_total",
+            "Prompt tokens prefilled across all engine streams",
+        )
+        self.prefill_latency = histogram(
+            "dllama_prefill_latency_seconds",
+            "Wall time of one batched prefill (dispatch+fetch, whole prompt)",
+        )
+        self.decode_latency = histogram(
+            "dllama_decode_latency_seconds",
+            "PER-TOKEN decode wall time, observed once per device dispatch "
+            "(a chunked dispatch contributes one observation at its per-token "
+            "average; dllama_tokens_generated_total counts the tokens)",
+        )
+        self.kv_occupancy = gauge(
+            "dllama_kv_cache_occupancy",
+            "KV-cache occupancy of the most recently active stream "
+            "(position / seq_len, 0..1)",
+        )
+        self.active_streams = gauge(
+            "dllama_engine_streams",
+            "Engine streams constructed (each owns one KV cache of HBM)",
+        )
+
+
+class CollectiveInstruments:
+    """The parallel backends' transfer-probe surface (TransferProbeMixin)."""
+
+    def __init__(self):
+        self.enabled = _enabled
+        self.span = span_factory()
+        self.allreduce_latency = histogram(
+            "dllama_allreduce_latency_seconds",
+            "Measured per-token collective (all-reduce/all-gather) cost from "
+            "the transfer probe, replayed on the real mesh",
+        )
+        self.allreduce_bytes = counter(
+            "dllama_allreduce_bytes_total",
+            "Estimated logical payload bytes moved by the collectives the "
+            "transfer probe replayed (per-token estimate x probe tokens)",
+        )
+        self.probe_runs = counter(
+            "dllama_transfer_probe_runs_total",
+            "Transfer-probe measurements taken (engine cadence: ~1/512 tokens)",
+        )
+
+
+class ServerInstruments:
+    """The API server's metric surface (bound once per ApiState)."""
+
+    def __init__(self):
+        self.enabled = _enabled
+        self.requests = counter(
+            "dllama_http_requests_total",
+            "HTTP requests by route and status code",
+            labelnames=("route", "status"),
+        )
+        self.request_duration = histogram(
+            "dllama_http_request_duration_seconds",
+            "End-to-end completion-request wall time (monotonic clock)",
+        )
+        self.inflight = gauge(
+            "dllama_http_requests_in_flight",
+            "Completion requests currently being served",
+        )
+        self.queue_wait = histogram(
+            "dllama_slot_queue_wait_seconds",
+            "Time a completion request waited for a free engine stream slot",
+        )
+
+
+class SamplerInstruments:
+    """Host-sampler distribution counters (bound once per Sampler)."""
+
+    def __init__(self):
+        self.enabled = _enabled
+        self.sampled = counter(
+            "dllama_sampled_tokens_total",
+            "Host-sampled tokens by method (greedy / topp); device-sampled "
+            "tokens are counted by dllama_tokens_generated_total instead",
+            labelnames=("method",),
+        )

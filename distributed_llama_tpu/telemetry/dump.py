@@ -1,0 +1,77 @@
+"""One-shot telemetry snapshots: ``python -m distributed_llama_tpu.telemetry.dump``.
+
+Two modes:
+
+* ``--url http://host:port`` — scrape a running server's ``/metrics``
+  endpoint and print the exposition text (or ``--format json`` to parse the
+  in-process snapshot is not possible remotely, so json mode is local-only).
+* no ``--url`` — print THIS process's registry (useful from a REPL or a
+  script that imported the engine; a fresh CLI invocation has an empty
+  registry unless ``DLLAMA_TELEMETRY=1`` and something ran).
+
+``--trace PATH`` additionally writes the span ring buffer as Chrome trace
+JSON (local mode only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="python -m distributed_llama_tpu.telemetry.dump")
+    p.add_argument(
+        "--url", default=None,
+        help="base URL (or full /metrics URL) of a running dllama-tpu-api "
+        "server to scrape instead of this process's registry",
+    )
+    p.add_argument(
+        "--format", choices=["prom", "json"], default="prom",
+        help="prom = Prometheus text exposition; json = registry snapshot "
+        "(local mode only)",
+    )
+    p.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="also write this process's span buffer as Chrome trace JSON",
+    )
+    return p
+
+
+def scrape(url: str, timeout: float = 10.0) -> str:
+    import urllib.request
+
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", errors="replace")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from distributed_llama_tpu import telemetry
+
+    if args.url:
+        if args.format == "json":
+            sys.stderr.write("--format json is local-only; scraping returns exposition text\n")
+        if args.trace:
+            sys.stderr.write(
+                "--trace is local-only (a scrape cannot read the remote span "
+                "buffer); no trace written\n"
+            )
+        sys.stdout.write(scrape(args.url))
+        return 0
+    if args.format == "json":
+        json.dump(telemetry.REGISTRY.snapshot(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(telemetry.prometheus_text())
+    if args.trace:
+        telemetry.export_chrome_trace(args.trace)
+        sys.stderr.write(f"wrote Chrome trace: {args.trace}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -228,6 +228,12 @@ class Sampler:
 
     def __post_init__(self):
         self._rng = XorshiftRng(self.seed)
+        # sampler-distribution counters (ISSUE 1): bound once per sampler —
+        # shared no-op singletons when telemetry is disabled, so the
+        # per-token host-sampling path never touches the registry
+        from distributed_llama_tpu import telemetry
+
+        self._tel = telemetry.SamplerInstruments()
 
     def set_seed(self, seed: int) -> None:
         self._rng = XorshiftRng(seed)
@@ -238,11 +244,14 @@ class Sampler:
     def sample(self, logits: np.ndarray) -> int:
         logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
         if self.temperature == 0.0:
+            self._tel.sampled.labels(method="greedy").inc()
             return int(np.argmax(logits))
         probs = _softmax(logits / self.temperature)
         coin = self._rng.next_f32()
         if self.topp <= 0 or self.topp >= 1:
+            self._tel.sampled.labels(method="multinomial").inc()
             return self._sample_mult(probs, coin)
+        self._tel.sampled.labels(method="topp").inc()
         return self._sample_topp(probs, coin)
 
     @staticmethod

@@ -300,6 +300,84 @@ class TestApiHardening:
         print(f"aggregate: {total} tokens across {len(streams_used)} concurrent "
               f"streams; B completed in {b_elapsed:.2f}s while A was open")
 
+    def test_metrics_endpoint_returns_prometheus_exposition(self, served):
+        """GET /metrics serves Prometheus text exposition of the global
+        registry (ISSUE 1 acceptance): with telemetry enabled and a
+        completion served, the engine's headline metrics are present with
+        real values."""
+        from distributed_llama_tpu import telemetry
+
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        telemetry.reset()
+        telemetry.enable()
+        old_engine_tel, old_server_tel = state.engine._tel, state.tel
+        try:
+            # rebind the instrument bundles now that telemetry is on (the
+            # bind-once contract: the fixture built them while disabled)
+            state.engine._tel = telemetry.EngineInstruments()
+            state.tel = telemetry.ServerInstruments()
+            with post(url, {"messages": [{"role": "user", "content": "hello"}],
+                            "max_tokens": 4}) as r:
+                json.loads(r.read())
+            with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+        finally:
+            state.engine._tel, state.tel = old_engine_tel, old_server_tel
+            telemetry.disable()
+            telemetry.reset()
+        assert "# TYPE dllama_tokens_generated_total counter" in text
+        assert "# TYPE dllama_decode_latency_seconds histogram" in text
+        assert "dllama_decode_latency_seconds_bucket" in text
+        assert "dllama_kv_cache_occupancy" in text
+        assert "dllama_http_requests_total" in text
+        # the completion above actually moved the counters
+        tokens_line = [
+            line for line in text.splitlines()
+            if line.startswith("dllama_tokens_generated_total")
+        ][0]
+        assert float(tokens_line.split()[-1]) > 0
+
+    def test_metrics_endpoint_without_telemetry_is_valid_and_sparse(self, served):
+        """A healthy server with telemetry disabled still answers /metrics
+        with 200 (scrapers must not see errors), just without engine series."""
+        url, _ = served
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        assert "dllama_tokens_generated_total" not in text
+
+    def test_error_response_carries_request_id(self, served):
+        """Errors are no longer anonymous: the body and the X-Request-Id
+        header carry the correlation id (satellite fix)."""
+        url, _ = served
+        req = urllib.request.Request(
+            url + "/v1/chat/completions", data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            rid = body["error"]["request_id"]
+            assert rid
+            assert e.headers["X-Request-Id"] == rid
+
+    def test_completion_id_uses_request_id(self, served):
+        url, state = served
+        state.engine.reset()
+        state.cache.clear()
+        with post(url, {"messages": [{"role": "user", "content": "hello"}],
+                        "max_tokens": 2}) as r:
+            rid = r.headers["X-Request-Id"]
+            data = json.loads(r.read())
+        assert rid
+        assert data["id"] == f"chatcmpl-{rid}"
+
     def test_streaming_engine_failure_sends_error_event(self, served):
         """An engine failure mid-stream must surface as a terminal SSE error
         event, not a silently truncated stream."""

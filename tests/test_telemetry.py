@@ -1,0 +1,442 @@
+"""Telemetry subsystem tests (ISSUE 1): registry semantics, span nesting,
+Prometheus exposition, Chrome trace export, disabled-mode no-op behavior,
+and the engine's instrument feeds.
+
+The reference has no observability surface at all — its only signals are
+per-token stat prints (src/apps/dllama/dllama.cpp:49-93)."""
+
+import json
+import threading
+
+import pytest
+
+from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.telemetry import (
+    MetricsRegistry,
+    SpanTracer,
+    Stopwatch,
+)
+from distributed_llama_tpu.telemetry.registry import DEFAULT_LATENCY_BUCKETS
+
+
+@pytest.fixture
+def enabled():
+    """Telemetry ON with a clean registry/tracer; restores disabled + clean
+    afterwards so test order never leaks global state."""
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+@pytest.fixture
+def disabled():
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+
+
+class TestRegistry:
+    def test_counter_semantics(self, enabled):
+        c = telemetry.counter("t_requests_total", "help text")
+        assert c.value == 0
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)  # counters only go up
+
+    def test_gauge_semantics(self, enabled):
+        g = telemetry.gauge("t_occupancy", "")
+        g.set(0.5)
+        assert g.value == 0.5
+        g.inc(0.25)
+        g.dec(0.5)
+        assert g.value == pytest.approx(0.25)
+
+    def test_histogram_semantics(self, enabled):
+        h = telemetry.histogram("t_latency_seconds", "", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+        counts = h.bucket_counts()
+        # Prometheus cumulative semantics: le=0.1 -> 1, le=1 -> 3, le=10 -> 4, +Inf -> 5
+        assert counts[0.1] == 1
+        assert counts[1.0] == 3
+        assert counts[10.0] == 4
+        assert counts[float("inf")] == 5
+
+    def test_default_buckets_span_us_to_s(self):
+        assert DEFAULT_LATENCY_BUCKETS[0] <= 1e-4  # µs-scale floor
+        assert DEFAULT_LATENCY_BUCKETS[-1] >= 5.0  # seconds-scale ceiling
+
+    def test_registration_is_idempotent(self, enabled):
+        a = telemetry.counter("t_same_total", "x")
+        b = telemetry.counter("t_same_total", "x")
+        assert a is b
+        with pytest.raises(ValueError):
+            telemetry.gauge("t_same_total")  # kind mismatch
+
+    def test_histogram_bucket_mismatch_raises(self, enabled):
+        telemetry.histogram("t_hb_seconds", buckets=(0.1, 1.0))
+        assert telemetry.histogram("t_hb_seconds", buckets=(1.0, 0.1)) is not None
+        with pytest.raises(ValueError):
+            telemetry.histogram("t_hb_seconds", buckets=(0.5, 5.0))
+
+    def test_labels(self, enabled):
+        c = telemetry.counter("t_by_route_total", "", labelnames=("route",))
+        c.labels(route="/a").inc()
+        c.labels(route="/a").inc()
+        c.labels(route="/b").inc(3)
+        assert c.labels(route="/a").value == 2
+        assert c.labels(route="/b").value == 3
+        with pytest.raises(ValueError):
+            c.inc()  # parent of a labelled metric holds no value
+        with pytest.raises(ValueError):
+            c.labels(wrong="x")
+
+    def test_thread_safety(self, enabled):
+        c = telemetry.counter("t_parallel_total")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+
+
+class TestExposition:
+    def test_prometheus_text_format(self, enabled):
+        c = telemetry.counter("t_tokens_total", "tokens generated")
+        c.inc(7)
+        g = telemetry.gauge("t_occ", "occupancy")
+        g.set(0.25)
+        h = telemetry.histogram("t_lat_seconds", "latency", buckets=(0.5, 5.0))
+        h.observe(0.1)
+        h.observe(1.0)
+        lc = telemetry.counter("t_routes_total", "by route", labelnames=("route",))
+        lc.labels(route="/metrics").inc()
+        text = telemetry.prometheus_text()
+        assert "# HELP t_tokens_total tokens generated" in text
+        assert "# TYPE t_tokens_total counter" in text
+        assert "t_tokens_total 7" in text
+        assert "t_occ 0.25" in text
+        assert "# TYPE t_lat_seconds histogram" in text
+        assert 't_lat_seconds_bucket{le="0.5"} 1' in text
+        assert 't_lat_seconds_bucket{le="5"} 2' in text
+        assert 't_lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "t_lat_seconds_sum 1.1" in text
+        assert "t_lat_seconds_count 2" in text
+        assert 't_routes_total{route="/metrics"} 1' in text
+        assert text.endswith("\n")
+
+    def test_zero_sample_metrics_still_exposed(self, enabled):
+        telemetry.counter("t_untouched_total", "never incremented")
+        telemetry.histogram("t_unused_seconds", "", buckets=(1.0,))
+        text = telemetry.prometheus_text()
+        assert "t_untouched_total 0" in text
+        assert 't_unused_seconds_bucket{le="+Inf"} 0' in text
+
+    def test_label_escaping(self, enabled):
+        c = telemetry.counter("t_esc_total", "", labelnames=("v",))
+        c.labels(v='a"b\\c\nd').inc()
+        text = telemetry.prometheus_text()
+        assert 'v="a\\"b\\\\c\\nd"' in text
+
+    def test_snapshot(self, enabled):
+        telemetry.counter("t_snap_total").inc(2)
+        snap = telemetry.REGISTRY.snapshot()
+        assert snap["t_snap_total"]["type"] == "counter"
+        assert snap["t_snap_total"]["series"][0]["value"] == 2
+        json.dumps(snap)  # JSON-able is part of the contract (dump helper)
+
+
+class TestTracer:
+    def test_span_nesting(self, enabled):
+        with telemetry.trace_span("outer", step=1):
+            with telemetry.trace_span("inner"):
+                pass
+            with telemetry.trace_span("inner2"):
+                pass
+        events = telemetry.TRACER.events()
+        by_name = {e.name: e for e in events}
+        assert set(by_name) == {"outer", "inner", "inner2"}
+        outer, inner = by_name["outer"], by_name["inner"]
+        assert outer.depth == 0 and inner.depth == 1
+        # inner spans lie inside the outer span's interval
+        for e in (inner, by_name["inner2"]):
+            assert e.ts_us >= outer.ts_us
+            assert e.ts_us + e.dur_us <= outer.ts_us + outer.dur_us + 1.0
+        assert outer.args == {"step": 1}
+
+    def test_ring_buffer_caps_events(self):
+        tr = SpanTracer(capacity=4)
+        for i in range(10):
+            with tr.span("s", i=i):
+                pass
+        events = tr.events()
+        assert len(events) == 4
+        assert [e.args["i"] for e in events] == [6, 7, 8, 9]  # oldest dropped
+
+    def test_chrome_trace_export(self, enabled, tmp_path):
+        with telemetry.trace_span("decode", step=3):
+            pass
+        path = str(tmp_path / "trace.json")
+        telemetry.export_chrome_trace(path)
+        with open(path) as f:
+            trace = json.load(f)
+        assert isinstance(trace["traceEvents"], list)
+        ev = trace["traceEvents"][0]
+        assert ev["name"] == "decode"
+        assert ev["ph"] == "X"
+        assert ev["dur"] >= 0
+        assert ev["args"]["step"] == 3
+
+    def test_exception_still_records_and_unwinds_depth(self, enabled):
+        with pytest.raises(RuntimeError):
+            with telemetry.trace_span("fails"):
+                raise RuntimeError("boom")
+        assert [e.name for e in telemetry.TRACER.events()] == ["fails"]
+        with telemetry.trace_span("after"):
+            pass
+        assert telemetry.TRACER.events()[-1].depth == 0  # depth unwound
+
+
+class TestDisabledMode:
+    def test_instruments_are_shared_noops(self, disabled):
+        c = telemetry.counter("t_never_total")
+        g = telemetry.gauge("t_never")
+        h = telemetry.histogram("t_never_seconds")
+        assert c is telemetry.NULL_COUNTER
+        assert g is telemetry.NULL_GAUGE
+        assert h is telemetry.NULL_HISTOGRAM
+        c.inc()
+        c.labels(anything="x").inc()
+        g.set(1.0)
+        h.observe(2.0)
+        assert c.value == 0 and g.value == 0 and h.count == 0
+        # the registry was never touched: nothing to expose
+        assert telemetry.REGISTRY.names() == []
+
+    def test_null_span_records_nothing(self, disabled):
+        with telemetry.trace_span("ghost", x=1) as s:
+            assert s is telemetry.NULL_SPAN
+        assert telemetry.TRACER.events() == []
+
+    def test_span_factory_binding(self, disabled):
+        f = telemetry.span_factory()
+        assert f("x") is telemetry.NULL_SPAN
+        telemetry.enable()
+        try:
+            f2 = telemetry.span_factory()
+            assert f2("x") is not telemetry.NULL_SPAN
+        finally:
+            telemetry.disable()
+
+
+class TestStopwatch:
+    def test_elapsed(self):
+        sw = Stopwatch()
+        assert sw.elapsed_ms() >= 0
+        assert sw.elapsed_s() >= 0
+        sw.restart()
+        assert sw.elapsed_ms() < 1000.0
+
+
+def _tiny_engine(tmp_path, seq_len=64):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.engine import InferenceEngine
+    from tests.model_utils import random_tensors, tiny_spec, write_model_file
+
+    spec = tiny_spec(seq_len=seq_len)
+    tensors = random_tensors(spec, seed=0)
+    model_path = str(tmp_path / "m.m")
+    write_model_file(model_path, spec, tensors)
+    return InferenceEngine(model_path, dtype=jnp.float32)
+
+
+class TestEngineInstrumentation:
+    def test_disabled_engine_never_mutates_registry(self, disabled, tmp_path):
+        """The acceptance criterion: with telemetry disabled, no registry
+        mutation occurs on the decode hot path."""
+        engine = _tiny_engine(tmp_path)
+        engine.prefill([1, 2, 3])
+        engine.decode_step(4)
+        engine.generate_on_device(first_token=5, n_steps=4)
+        assert telemetry.REGISTRY.names() == []
+        assert telemetry.TRACER.events() == []
+
+    def test_enabled_engine_feeds_registry(self, enabled, tmp_path):
+        engine = _tiny_engine(tmp_path)
+        engine.prefill([1, 2, 3])
+        engine.decode_step(4)
+        engine.generate_on_device(first_token=5, n_steps=4)
+
+        reg = telemetry.REGISTRY
+        assert reg.get("dllama_prompt_tokens_total").value == 3
+        assert reg.get("dllama_tokens_generated_total").value == 5  # 1 + 4
+        assert reg.get("dllama_prefill_latency_seconds").count == 1
+        assert reg.get("dllama_decode_latency_seconds").count >= 2
+        occupancy = reg.get("dllama_kv_cache_occupancy").value
+        assert occupancy == pytest.approx(engine.pos / engine.cfg.seq_len)
+        assert reg.get("dllama_engine_streams").value == 1
+        # the span tracer saw the forward/prefill phases
+        names = {e.name for e in telemetry.TRACER.events()}
+        assert "prefill" in names and "forward" in names
+
+    def test_fused_prefill_defers_latency_to_fetch(self, enabled, tmp_path):
+        engine = _tiny_engine(tmp_path)
+        first, _key = engine.prefill_device([1, 2, 3], temperature=0.0, topp=0.9, seed=0)
+        reg = telemetry.REGISTRY
+        # prompt tokens count at dispatch; the latency observation waits for
+        # the first-token fetch (where the entry gains its drain time)
+        assert reg.get("dllama_prompt_tokens_total").value == 3
+        assert reg.get("dllama_prefill_latency_seconds").count == 0
+        tok = engine.fetch_first_token(first)
+        assert isinstance(tok, int)
+        assert reg.get("dllama_prefill_latency_seconds").count == 1
+        # the fused first token is GENERATED (it belongs to no decode chunk)
+        assert reg.get("dllama_tokens_generated_total").value == 1
+
+    def test_generate_chunks_counts_tokens(self, enabled, tmp_path):
+        engine = _tiny_engine(tmp_path)
+        engine.prefill([1, 2, 3])
+        toks = []
+        for t in engine.generate_chunks(first_token=4, chunk=4, limit=12):
+            toks.append(t)
+        reg = telemetry.REGISTRY
+        assert reg.get("dllama_tokens_generated_total").value == len(toks)
+        names = {e.name for e in telemetry.TRACER.events()}
+        assert "decode_chunk_fetch" in names
+
+
+class TestSamplerInstrumentation:
+    def test_sampler_distribution_counters(self, enabled):
+        import numpy as np
+
+        from distributed_llama_tpu.tokenizer import Sampler
+
+        logits = np.linspace(0, 1, 16).astype(np.float32)
+        Sampler(vocab_size=16, temperature=0.0).sample(logits)
+        Sampler(vocab_size=16, temperature=0.7, topp=0.9, seed=1).sample(logits)
+        Sampler(vocab_size=16, temperature=0.7, topp=1.0, seed=1).sample(logits)
+        c = telemetry.REGISTRY.get("dllama_sampled_tokens_total")
+        assert c.labels(method="greedy").value == 1
+        assert c.labels(method="topp").value == 1
+        assert c.labels(method="multinomial").value == 1
+
+
+class TestCollectiveInstruments:
+    """The TransferProbeMixin telemetry feed, exercised through a stub
+    backend (the real TP/SP/EP backends need a mesh; the mixin's timing +
+    recording machinery is backend-agnostic)."""
+
+    class _StubBackend:
+        # minimal duck-typed backend: the mixin needs transfer_probe() and
+        # a _decode_cache dict
+        def __init__(self):
+            self._decode_cache = {}
+
+        def transfer_probe(self, n_tokens):
+            import jax
+            import jax.numpy as jnp
+
+            return jax.jit(lambda x: (x + 1.0,)), (jnp.zeros(4),)
+
+        def transfer_bytes_per_token(self):
+            return 1000
+
+    def _backend(self):
+        from distributed_llama_tpu.parallel.tensor_parallel import TransferProbeMixin
+
+        class B(self._StubBackend, TransferProbeMixin):
+            pass
+
+        return B()
+
+    def test_measure_records_latency_and_bytes(self, enabled):
+        b = self._backend()
+        ms = b.measure_transfer_ms(n_tokens=8)
+        assert ms >= 0
+        reg = telemetry.REGISTRY
+        assert reg.get("dllama_transfer_probe_runs_total").value == 1
+        assert reg.get("dllama_allreduce_latency_seconds").count == 1
+        assert reg.get("dllama_allreduce_bytes_total").value == 8000  # 1000 x 8
+        assert "transfer_probe" in {e.name for e in telemetry.TRACER.events()}
+
+    def test_measure_disabled_touches_nothing(self, disabled):
+        b = self._backend()
+        assert b.measure_transfer_ms(n_tokens=4) >= 0
+        assert telemetry.REGISTRY.names() == []
+        assert telemetry.TRACER.events() == []
+
+    def test_backend_byte_estimates_are_positive(self):
+        """The per-backend transfer_bytes_per_token overrides, on config
+        objects only (no mesh needed)."""
+        import types
+
+        from distributed_llama_tpu.parallel.context_parallel import (
+            SequenceParallelForward,
+        )
+        from distributed_llama_tpu.parallel.expert_parallel import (
+            ExpertParallelForward,
+        )
+        from distributed_llama_tpu.parallel.tensor_parallel import (
+            TensorParallelForward,
+        )
+
+        cfg = types.SimpleNamespace(
+            n_layers=4, dim=64, vocab_size=128, n_kv_heads=4, n_heads=8, head_size=8
+        )
+        tp = TensorParallelForward.__new__(TensorParallelForward)
+        tp.cfg, tp.shard_vocab = cfg, True
+        assert tp.transfer_bytes_per_token() == 2 * 4 * 64 * 4 + 128 * 4
+
+        sp = SequenceParallelForward.__new__(SequenceParallelForward)
+        sp.cfg, sp.tp, sp._tp_axis = cfg, 2, "tp"
+        assert sp.transfer_bytes_per_token() > 0
+
+        ep = ExpertParallelForward.__new__(ExpertParallelForward)
+        ep.cfg, ep._tp_axis = cfg, None
+        assert ep.transfer_bytes_per_token() == 4 * 64 * 4
+
+
+class TestDumpHelper:
+    def test_local_prom_dump(self, enabled, capsys):
+        from distributed_llama_tpu.telemetry import dump
+
+        telemetry.counter("t_dump_total", "x").inc(4)
+        assert dump.main([]) == 0
+        out = capsys.readouterr().out
+        assert "t_dump_total 4" in out
+
+    def test_local_json_dump_with_trace(self, enabled, capsys, tmp_path):
+        from distributed_llama_tpu.telemetry import dump
+
+        telemetry.gauge("t_dump_g").set(1.5)
+        with telemetry.trace_span("dumped"):
+            pass
+        trace_path = str(tmp_path / "t.json")
+        assert dump.main(["--format", "json", "--trace", trace_path]) == 0
+        out = capsys.readouterr().out
+        assert json.loads(out)["t_dump_g"]["series"][0]["value"] == 1.5
+        with open(trace_path) as f:
+            assert json.load(f)["traceEvents"][0]["name"] == "dumped"
+
+
+class TestRegistryIsolation:
+    def test_fresh_registry_object(self):
+        """MetricsRegistry instances are independent (the global is just the
+        default); sanity for embedding several engines in one process."""
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.counter("only_in_r1").inc()
+        assert r2.get("only_in_r1") is None
