@@ -371,6 +371,151 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     }
 
 
+def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
+              n_rounds: int = 4, weights: str = "q40") -> dict:
+    """Batched multi-stream decode vs B interleaved single-sequence streams
+    (`bench.py --batch-decode B`): the aggregate-tok/s scaling proof of the
+    batch scheduler. Decode is HBM-bound, so B interleaved single-sequence
+    dispatches serialize on the weight reads (round-5 measured 97.3 vs 95.8
+    tok/s — fairness, not tokens); the batched step reads each weight matrix
+    once for all B rows. Both paths replay identical fixed position windows,
+    interleaved-free medians of 3 like run()."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random
+
+    from distributed_llama_tpu.engine.batch import _slab_prefill_single
+    from distributed_llama_tpu.engine.weights import random_params_on_device
+    from distributed_llama_tpu.models import llama
+    from distributed_llama_tpu.models.sampling import decode_chunk, decode_chunk_batched
+
+    if weights == "q40":
+        params = random_q40_params_on_device(cfg)
+    else:
+        params = random_params_on_device(cfg, dtype=jnp.bfloat16, seed=0, layered=True)
+
+    rng = np.random.RandomState(0)
+    prompts = [
+        jnp.asarray(rng.randint(0, cfg.vocab_size, prefill_len, dtype=np.int32))
+        for _ in range(B)
+    ]
+    base = prefill_len  # decode window [base, base + n_rounds*chunk), replayed per rep
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+    def fwd(cfg_, params_, tokens, cache, pos):
+        return llama.forward_tokens(cfg_, params_, tokens, cache, pos)
+
+    # ---- baseline: B interleaved single-sequence streams -----------------
+    caches = [llama.init_cache(cfg, dtype=jnp.bfloat16, layered=True) for _ in range(B)]
+    tok_dev = []
+    for i in range(B):
+        logits, caches[i] = fwd(cfg, params, prompts[i], caches[i], jnp.int32(0))
+        tok_dev.append(jnp.argmax(logits[-1]).astype(jnp.int32))
+    keys = [jax.random.PRNGKey(i) for i in range(B)]
+    # warm/compile the chunk shape once
+    warm, caches[0], keys[0] = decode_chunk(
+        cfg, params, tok_dev[0], caches[0], jnp.int32(base), chunk,
+        jnp.float32(0.0), jnp.float32(0.9), keys[0],
+    )
+    np.asarray(warm)
+    single_runs = []
+    for rep in range(3):
+        pos = [base] * B
+        with telemetry.trace_span("bench_batch_interleaved", rep=rep, b=B):
+            sw = Stopwatch()
+            last = None
+            for _ in range(n_rounds):
+                for i in range(B):
+                    toks, caches[i], keys[i] = decode_chunk(
+                        cfg, params, tok_dev[i], caches[i], jnp.int32(pos[i]),
+                        chunk, jnp.float32(0.0), jnp.float32(0.9), keys[i],
+                    )
+                    tok_dev[i] = toks[-1]
+                    pos[i] += chunk
+                    last = toks
+            np.asarray(last)  # fence: every dispatched chunk must finish
+            single_runs.append(B * n_rounds * chunk / sw.elapsed_s())
+    interleaved_tps = sorted(single_runs)[1]
+    del caches
+    gc.collect()
+
+    # ---- batched: one slab, one dispatch per chunk for all B rows --------
+    slab = llama.init_batch_cache(cfg, B, dtype=jnp.bfloat16)
+    firsts = []
+    for i in range(B):
+        logits, slab = _slab_prefill_single(
+            cfg, params, prompts[i], slab, jnp.int32(i), jnp.int32(0),
+            jnp.int32(prefill_len),
+        )
+        firsts.append(jnp.argmax(logits[-1]).astype(jnp.int32))
+    first = jnp.stack(firsts)
+    active = jnp.ones(B, bool)
+    temps = jnp.zeros(B, jnp.float32)
+    topps = jnp.full(B, 0.9, jnp.float32)
+    bkeys = jnp.stack([jax.random.PRNGKey(i) for i in range(B)])
+    pos0 = jnp.full(B, base, jnp.int32)
+    toks, slab, bkeys = decode_chunk_batched(  # warm/compile
+        cfg, params, first, slab, pos0, active, chunk, temps, topps, bkeys
+    )
+    np.asarray(toks)
+    batch_runs = []
+    for rep in range(3):
+        pos = pos0
+        nxt = toks[-1]
+        with telemetry.trace_span("bench_batch_decode", rep=rep, b=B):
+            sw = Stopwatch()
+            for _ in range(n_rounds):
+                toks_r, slab, bkeys = decode_chunk_batched(
+                    cfg, params, nxt, slab, pos, active, chunk, temps, topps, bkeys
+                )
+                nxt = toks_r[-1]
+                pos = pos + chunk
+            np.asarray(toks_r)
+            batch_runs.append(B * n_rounds * chunk / sw.elapsed_s())
+    batched_tps = sorted(batch_runs)[1]
+
+    speedup = batched_tps / interleaved_tps if interleaved_tps else 0.0
+    return {
+        "metric": f"{name}_{weights}_batch_decode_b{B}_aggregate_tokens_per_sec",
+        "value": round(bench_metric(f"batch_decode_b{B}_aggregate_tps", batched_tps,
+                                    "tokens/sec"), 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(bench_metric(f"batch_decode_b{B}_vs_interleaved", speedup), 2),
+        "detail": {
+            "interleaved_singles_aggregate_tokens_per_sec": round(
+                bench_metric(f"batch_decode_b{B}_interleaved_tps", interleaved_tps,
+                             "tokens/sec"), 2),
+            "per_stream_tokens_per_sec": round(batched_tps / B, 2),
+            "b": B,
+            "chunk": chunk,
+            "baseline": "B round-robin-interleaved single-sequence chunked "
+            "decode streams on the same chip (docs/PERF.md round-5 item 4)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def main_batch(b: int):
+    import gc
+
+    result = None
+    try:
+        result = run_batch(llama2_7b_config(1024), "llama2_7b", b, weights="q40")
+    except Exception as e:  # OOM on small accelerators → bench the 1.1B config
+        sys.stderr.write(
+            f"7B batch bench failed ({type(e).__name__}: {e}); "
+            "falling back to TinyLlama config\n"
+        )
+    if result is None:
+        gc.collect()
+        result = run_batch(tinyllama_config(1024), "tinyllama_1_1b", b, weights="q40")
+    print(json.dumps(result))
+
+
 def main():
     import gc
 
@@ -453,6 +598,12 @@ if __name__ == "__main__":
         main_single("q40")
     elif "--bf16-only" in sys.argv:
         main_single("bf16")
+    elif "--batch-decode" in sys.argv:
+        # batched multi-stream decode vs B interleaved single streams (the
+        # ISSUE 2 aggregate-throughput proof; numbers → docs/PERF.md)
+        idx = sys.argv.index("--batch-decode")
+        b = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 4
+        main_batch(b)
     elif "--mixtral-only" in sys.argv:
         # multi-model probe (BASELINE config 3's shape class): one-chip
         # Mixtral-shaped MoE decode/prefill; not part of the default line —

@@ -2,4 +2,14 @@
 
 from distributed_llama_tpu.engine.engine import InferenceEngine
 
-__all__ = ["InferenceEngine"]
+
+def __getattr__(name):
+    # lazy: batch pulls in the scheduler machinery only when asked for
+    if name in ("BatchScheduler", "BatchStream"):
+        from distributed_llama_tpu.engine import batch
+
+        return getattr(batch, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["InferenceEngine", "BatchScheduler", "BatchStream"]

@@ -1,0 +1,620 @@
+"""Batched multi-stream decode: one weight read per step for B requests.
+
+Decode is HBM-bound — the weight bytes dominate every step (docs/PERF.md) —
+so ``--parallel N`` serving built on N independent single-sequence dispatches
+buys fairness, not tokens: the dispatches queue on the device stream and
+each one re-reads every weight matrix (measured 97.3 tok/s aggregate vs
+95.8 single-stream, round 5). Batching the step over B sequences amortizes
+each weight read across all active requests — the Orca/vLLM
+continuous-batching insight — for near-B× aggregate throughput at modest B
+with no new hardware.
+
+Architecture
+------------
+* :class:`BatchScheduler` owns ONE slab KV cache
+  (``llama.init_batch_cache``: per-layer ``(keys, values)`` halves with a
+  leading ``[B_max]`` batch axis) and coalesces every joined stream's next
+  chunk into ONE batched dispatch
+  (``sampling.decode_chunk_batched`` / the tp backend's
+  ``batched_decode_chunk``).
+* :class:`BatchStream` is one slab row wearing the
+  :class:`~distributed_llama_tpu.engine.engine.EngineStream` serving
+  surface (``prefill_device`` / ``stream_decode`` / ``rollback`` / ...), so
+  the API server's ``StreamSlot``s submit into the shared scheduler without
+  changing the completion flow (SSE streaming, per-request stop/seed and
+  the chat-prefix NaiveCache all ride on top unchanged).
+* Requests join and leave BETWEEN chunks without recompiling: dispatches
+  run at fixed power-of-two row buckets (1/2/4/8..., mirroring
+  ``_prefill_bucket``) with an active-row mask — an inactive row decodes
+  garbage into a DROPPED cache write (``kv_cache.update_row_batched``), so
+  a retired slot's cache stays byte-identical for its next prefix reuse.
+* Prefill stays per-request: ``_slab_prefill`` runs the ordinary
+  single-sequence forward on the stream's slab row (extracted and
+  re-inserted inside the jitted program; the donated slab aliases in
+  place), reusing the whole blocked-attention/i8/bucketing machinery.
+* Per-row PRNG keys, temperatures and top-p thread through the batched
+  program, so a row's token stream is bit-identical to the single-stream
+  chunked decode for the same per-row key (tests/test_batch_decode.py) and
+  requests with different sampling settings share one compiled program.
+  (MoE models: the batched step uses dense expert mixing — parity holds up
+  to expert-sum reordering, and expert HBM reads amortize only once
+  B ≥ E/k; see ``llama.forward_step_batched``.)
+
+Thread model: request threads call into their own :class:`BatchStream`;
+whichever thread needs tokens first becomes the dispatcher for everyone
+(dispatch under the scheduler condition lock — cheap, asynchronous — then
+the blocking fetch outside it). Joins/leaves take the same lock, so the
+active set is coherent per dispatch; an epoch counter per stream keeps a
+late fetch from delivering a previous request's tokens to a new occupant.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket
+from distributed_llama_tpu.models import llama
+from distributed_llama_tpu.models.config import LlamaConfig
+from distributed_llama_tpu.ops import kv_cache as kvc
+from distributed_llama_tpu.telemetry import Stopwatch
+
+
+def decode_bucket(n: int, b_max: int) -> int:
+    """Power-of-two row bucket covering rows 0..n-1 (capped at b_max): one
+    compiled batched program per bucket, holes masked inactive."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, b_max)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def _slab_prefill_single(cfg: LlamaConfig, params, tokens, slab, row, pos, n_real):
+    """Prefill ``tokens`` into slab row ``row`` (single chip): the row is
+    extracted as an ordinary single-stream cache, run through the normal
+    forward (blocked attention, i8 quantization, MoE bucketing — all
+    reused), and written back; the donated slab aliases every other row in
+    place. Returns (logits [T, vocab], new slab)."""
+    row_cache = [
+        (kvc.slab_take_row(k, row), kvc.slab_take_row(v, row)) for k, v in slab
+    ]
+    logits, new_rows = llama.forward_tokens(
+        cfg, params, tokens, row_cache, pos, n_real=n_real
+    )
+    new_slab = [
+        (kvc.slab_put_row(k, nk, row), kvc.slab_put_row(v, nv, row))
+        for (k, v), (nk, nv) in zip(slab, new_rows)
+    ]
+    return logits, new_slab
+
+
+class BatchStream:
+    """One slab row of a :class:`BatchScheduler`, wearing the EngineStream
+    serving surface. All mutable request state (position, queue, sampler
+    settings, the device-resident next-token scalar) lives here; the
+    scheduler snapshots it per batched dispatch under its lock."""
+
+    def __init__(self, scheduler: "BatchScheduler", row: int):
+        self.scheduler = scheduler
+        self.row = row
+        self.pos = 0
+        self.stats: list[TokenStats] = []
+        # register with the engine's stream list: the TP transfer-refresh
+        # cadence counts tokens across ALL streams' stats, and batched
+        # serving must keep driving the periodic re-measurement
+        engine = scheduler.engine
+        engine._streams.append(self)
+        engine._tel.active_streams.set(len(engine._streams))
+        self._queue: collections.deque[int] = collections.deque()
+        self._joined = False
+        self._epoch = 0  # bumped per join/leave: stale fetches can't deliver
+        self._first = None  # device scalar (or host int) feeding the next chunk
+        self._key = None  # per-row PRNG key, advanced per chunk
+        self._temperature = 0.0
+        self._topp = 0.9
+        self._pending_prefill_entry: TokenStats | None = None
+        self._depth_held = False
+        # a failed chunk fetch poisons every co-batched stream (their
+        # positions already advanced at dispatch — continuing would emit a
+        # silent token hole); next_token raises it instead
+        self._fetch_error: BaseException | None = None
+
+    @property
+    def cfg(self):
+        return self.scheduler.engine.cfg
+
+    @property
+    def engine(self):
+        return self.scheduler.engine
+
+    # ------------------------------------------------------------------
+    # EngineStream-compatible lifecycle
+    # ------------------------------------------------------------------
+
+    def reset(self) -> None:
+        self.scheduler._leave(self)
+        self.pos = 0
+        # same cadence no-op contract as EngineStream.reset(): clearing this
+        # stream's stats shrinks the engine-wide token sum, so the transfer
+        # watermark shifts down by the same amount
+        cleared = sum(s.n_tokens for s in self.stats)
+        engine = self.engine
+        with engine._depth_lock:
+            engine._transfer_measured_at -= cleared
+        self.stats.clear()
+        self._release_depth()
+        self._pending_prefill_entry = None
+        self._fetch_error = None
+
+    def rollback(self, pos: int) -> None:
+        """Rewind to ``pos`` (prefix-cache reuse / early-stop contract).
+        Slab slots beyond ``pos`` — including any written by an in-flight
+        speculative chunk — are stale but unreachable: attention masks
+        s <= pos and the next prefill overwrites them before the position
+        pointer crosses."""
+        if not 0 <= pos <= self.pos:
+            raise ValueError(f"cannot rollback to {pos} from {self.pos}")
+        self.pos = pos
+
+    # ------------------------------------------------------------------
+    # Prefill (per-request, on this stream's slab row)
+    # ------------------------------------------------------------------
+
+    def prefill(self, tokens) -> np.ndarray:
+        """Batched-prompt prefill into this slab row; returns the last
+        token's logits row (only that row crosses the host boundary)."""
+        self._release_depth()
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = tokens.shape[0]
+        engine = self.engine
+        sw = Stopwatch()
+        with engine._tel.span("prefill", tokens=n, pos=self.pos, batch_row=self.row):
+            logits = self.scheduler._prefill_row(self, tokens)
+            out = np.asarray(logits[n - 1])
+        entry = engine._split_stats(sw.elapsed_ms(), n_tokens=n)
+        self.stats.append(entry)
+        if engine._tel.enabled:
+            engine._tel.prompt_tokens.inc(n)
+            engine._tel.prefill_latency.observe(entry.generation_ms / 1000.0)
+            engine._tel.kv_occupancy.set(self.pos / engine.cfg.seq_len)
+        return out
+
+    def prefill_device(self, tokens, temperature, topp, seed: int):
+        """Prefill + sample the first token ON DEVICE (the prefill→decode
+        fusion of EngineStream.prefill_device, on this slab row): returns
+        (device token scalar, PRNG key) — nothing visits the host until the
+        fused first-token fetch overlaps chunk 1's compute."""
+        engine = self.engine
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = tokens.shape[0]
+        sw = Stopwatch()
+        self._hold_depth()
+        try:
+            with engine._tel.span(
+                "prefill_dispatch", tokens=n, pos=self.pos, batch_row=self.row
+            ):
+                logits = self.scheduler._prefill_row(self, tokens)
+                key = jax.random.PRNGKey(seed)
+                key, sub = jax.random.split(key)
+                token = engine._sample_row(
+                    logits, jnp.int32(n - 1), sub,
+                    jnp.float32(temperature), jnp.float32(topp),
+                )
+            entry = engine._split_stats(sw.elapsed_ms(), n_tokens=n)
+            self.stats.append(entry)
+            self._pending_prefill_entry = entry
+            if engine._tel.enabled:
+                engine._tel.prompt_tokens.inc(n)
+        except BaseException:
+            self._release_depth()
+            raise
+        return token, key
+
+    def fetch_first_token(self, first_token) -> int:
+        """Fetch a :meth:`prefill_device` token without starting a decode
+        stream (the 1-token-completion fast path)."""
+        return self._fetch_fused_first(first_token)
+
+    def _fetch_fused_first(self, first_token) -> int:
+        """Blocking fetch of the device-sampled first token; the drain time
+        joins the prefill's stats entry (the dispatch-only timing would
+        otherwise under-report prefill latency — same contract as
+        EngineStream._fetch_fused_first)."""
+        engine = self.engine
+        sw = Stopwatch()
+        with engine._tel.span("first_token_fetch", batch_row=self.row):
+            tok = int(np.asarray(first_token))
+        self._release_depth()
+        drained_ms = sw.elapsed_ms()
+        entry = self._pending_prefill_entry
+        if entry is not None:
+            entry.generation_ms += drained_ms
+            entry.inference_ms += drained_ms
+            self._pending_prefill_entry = None
+            tel = engine._tel
+            if tel.enabled:
+                tel.prefill_latency.observe(entry.generation_ms / 1000.0)
+                tel.tokens_generated.inc(1)
+                tel.kv_occupancy.set(self.pos / engine.cfg.seq_len)
+        return tok
+
+    def _hold_depth(self) -> None:
+        engine = self.engine
+        with engine._depth_lock:
+            if not self._depth_held:
+                engine._pipeline_depth += 1
+                self._depth_held = True
+
+    def _release_depth(self) -> None:
+        engine = self.engine
+        with engine._depth_lock:
+            if self._depth_held:
+                engine._pipeline_depth -= 1
+                self._depth_held = False
+
+    # ------------------------------------------------------------------
+    # Decode (through the shared batched dispatch)
+    # ------------------------------------------------------------------
+
+    def stream_decode(
+        self,
+        first_token,
+        on_token,
+        temperature: float = 0.0,
+        topp: float = 0.9,
+        seed: int = 0,
+        chunk: int | None = None,
+        limit: int | None = None,
+        key=None,
+        first_prev: int | None = None,
+    ) -> int:
+        """EngineStream.stream_decode over the shared batched dispatch: this
+        stream joins the scheduler's active set and consumes its row of
+        every batched chunk; other streams' chunks ride the same weight
+        reads. ``chunk`` is accepted for signature parity but the scheduler's
+        shared chunk size governs (all coalesced rows must step together).
+        Owns the early-stop rollback contract; returns tokens consumed."""
+        engine = self.engine
+        sched = self.scheduler
+        if key is None:
+            key = jax.random.PRNGKey(seed)
+        start_pos = self.pos
+        stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
+        fused_first = first_prev is not None
+        prev = first_prev if fused_first else int(first_token)
+        consumed = 0
+        keep = True
+        sched._join(self, first_token, temperature, topp, key)
+        try:
+            if fused_first:
+                # dispatch chunk 1 before the fused fetch so the scalar
+                # fetch overlaps the chunk's compute (the prefill_device
+                # round-trip elision, batched)
+                sched.kick()
+                tok = self._fetch_fused_first(first_token)
+                consumed += 1
+                keep = on_token(prev, tok)
+                prev = tok
+            while keep is not False:
+                fed = consumed - 1 if fused_first else consumed
+                if start_pos + fed >= stop:
+                    break
+                tok = sched.next_token(self)
+                consumed += 1
+                keep = on_token(prev, tok)
+                prev = tok
+        finally:
+            sched._leave(self)
+            fed = max(consumed - 1, 0) if fused_first else consumed
+            self.rollback(min(start_pos + fed, self.pos))
+        return consumed
+
+    # ------------------------------------------------------------------
+    # Stats (EngineStream parity)
+    # ------------------------------------------------------------------
+
+    def avg_stats(self) -> TokenStats:
+        if not self.stats:
+            return TokenStats(0.0, 0.0, 0.0)
+        n = sum(s.n_tokens for s in self.stats)
+        return TokenStats(
+            sum(s.generation_ms for s in self.stats) / n,
+            sum(s.inference_ms for s in self.stats) / n,
+            sum(s.transfer_ms for s in self.stats) / n,
+            n_tokens=n,
+        )
+
+    def total_tokens(self) -> int:
+        return sum(s.n_tokens for s in self.stats)
+
+
+class BatchScheduler:
+    """Owns the ``[B_max]`` slab cache and coalesces joined streams into
+    one batched decode dispatch per chunk. Supported on the single-chip and
+    tensor-parallel backends (the sp/ep backends keep their single-stream
+    programs)."""
+
+    def __init__(self, engine, n_rows: int, chunk: int = 32):
+        tp_engine = engine._tp_engine
+        if tp_engine is not None and not hasattr(tp_engine, "batched_decode_chunk"):
+            raise ValueError(
+                "batched decode is supported on the single-chip and tp "
+                "backends only (sp/ep keep single-stream dispatches)"
+            )
+        if n_rows < 1:
+            raise ValueError(f"need at least one batch row, got {n_rows}")
+        self.engine = engine
+        self.b_max = n_rows
+        self.chunk = int(chunk)
+        if tp_engine is None:
+            self._slab = llama.init_batch_cache(
+                engine.cfg, n_rows, dtype=engine.cache_dtype
+            )
+        else:
+            self._slab = tp_engine.init_batch_cache(n_rows, dtype=engine.cache_dtype)
+        self._streams: list[BatchStream] = []
+        self._cond = threading.Condition()
+        # one dispatched-but-unfetched chunk at a time: (tokens_dev, epoch
+        # snapshot, bucket, active count, stopwatch)
+        self._pending = None
+        self._fetching = False
+
+    def new_stream(self) -> BatchStream:
+        """Hand out the next slab row as an EngineStream-like serving lane."""
+        with self._cond:
+            if len(self._streams) >= self.b_max:
+                raise ValueError(f"all {self.b_max} batch rows are allocated")
+            s = BatchStream(self, len(self._streams))
+            self._streams.append(s)
+            return s
+
+    # ------------------------------------------------------------------
+    # Prefill dispatch (serialized with batched chunks via the cond lock:
+    # every dispatch consumes and replaces the donated slab)
+    # ------------------------------------------------------------------
+
+    def _prefill_row(self, stream: BatchStream, tokens: np.ndarray):
+        engine = self.engine
+        n = tokens.shape[0]
+        if n == 0:
+            raise ValueError("empty token batch: at least one token required")
+        if stream.pos + n > engine.cfg.seq_len:
+            raise ValueError(
+                f"context overflow: pos {stream.pos} + {n} > {engine.cfg.seq_len}"
+            )
+        bucket = _prefill_bucket(n)
+        if stream.pos + bucket > engine.cfg.seq_len:
+            bucket = n  # exact-length compile near the context limit
+        padded = np.zeros(bucket, dtype=np.int32)
+        padded[:n] = tokens
+        with self._cond:
+            if engine._tp_engine is None:
+                logits, self._slab = _slab_prefill_single(
+                    engine.cfg, engine.params, jnp.asarray(padded), self._slab,
+                    jnp.int32(stream.row), jnp.int32(stream.pos), jnp.int32(n),
+                )
+            else:
+                logits, self._slab = engine._tp_engine.slab_forward(
+                    engine.params, jnp.asarray(padded), self._slab,
+                    stream.row, stream.pos, n,
+                )
+            stream.pos += n
+        return logits
+
+    # ------------------------------------------------------------------
+    # Join/leave (between chunks; the cond lock makes the active set
+    # coherent per dispatch)
+    # ------------------------------------------------------------------
+
+    def _join(self, stream: BatchStream, first_token, temperature, topp, key) -> None:
+        with self._cond:
+            stream._first = first_token
+            stream._temperature = float(temperature)
+            stream._topp = float(topp)
+            stream._key = key
+            stream._queue.clear()
+            stream._epoch += 1
+            stream._joined = True
+            stream._fetch_error = None
+            self._cond.notify_all()
+
+    def _leave(self, stream: BatchStream) -> None:
+        with self._cond:
+            if not stream._joined and not stream._queue:
+                return
+            stream._joined = False
+            stream._queue.clear()
+            stream._epoch += 1
+            self._cond.notify_all()
+        # a request that stopped at its fused first token (immediate EOS)
+        # may leave its kicked chunk dispatched-but-unfetched; if no joined
+        # stream remains to fetch it, drain it now — otherwise the engine
+        # pipeline depth stays held across the idle period and the transfer
+        # probe treats the engine as permanently mid-flight
+        self._drain_if_idle()
+
+    def _drain_if_idle(self) -> None:
+        pend = None
+        with self._cond:
+            if (
+                self._pending is not None
+                and not self._fetching
+                and not any(s._joined for s in self._streams)
+            ):
+                pend = self._pending
+                self._pending = None
+                self._fetching = True
+        if pend is not None:
+            self._fetch(pend)
+
+    def kick(self) -> None:
+        """Dispatch a batched chunk now if none is in flight (used to start
+        chunk 1 before the fused first-token fetch so the fetch overlaps
+        the chunk's compute)."""
+        with self._cond:
+            if self._pending is None:
+                self._dispatch_locked()
+
+    # ------------------------------------------------------------------
+    # The pump: dispatch under the lock, fetch outside it
+    # ------------------------------------------------------------------
+
+    def next_token(self, stream: BatchStream) -> int:
+        """Next decoded token for ``stream``; whichever thread runs dry
+        first dispatches/fetches the shared batched chunk for everyone."""
+        while True:
+            pend = None
+            with self._cond:
+                if stream._fetch_error is not None:
+                    err = stream._fetch_error
+                    stream._fetch_error = None
+                    raise RuntimeError(
+                        "batched decode chunk fetch failed; this stream's "
+                        "tokens were lost"
+                    ) from err
+                if stream._queue:
+                    return stream._queue.popleft()
+                if not stream._joined:
+                    raise RuntimeError("next_token on a stream that left the batch")
+                if self._pending is None:
+                    # dispatch even while another thread is mid-fetch: the
+                    # next chunk's compute then overlaps the fetch round
+                    # trip (the batched analogue of generate_chunks'
+                    # speculative pipelining; at most ONE chunk runs ahead
+                    # — the single pending slot bounds it)
+                    self._dispatch_locked()
+                if self._pending is not None and not self._fetching:
+                    pend = self._pending
+                    self._pending = None
+                    self._fetching = True
+                else:
+                    # another thread is mid-fetch: wait for its notify
+                    self._cond.wait(timeout=0.1)
+                    continue
+            self._fetch(pend)
+
+    def _dispatch_locked(self) -> None:
+        """Build and dispatch one batched chunk from the joined streams
+        (cond lock held; the dispatch itself is asynchronous). Rows inside
+        the bucket that are not joined ride along masked-inactive: their
+        cache writes DROP and their outputs are discarded."""
+        engine = self.engine
+        joined = [s for s in self._streams if s._joined]
+        if not joined:
+            return
+        bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
+        rows = self._streams[:bucket]
+        zero_key = jax.random.PRNGKey(0)
+        first = jnp.stack(
+            [jnp.asarray(s._first if s._joined else 0, jnp.int32) for s in rows]
+        )
+        pos = jnp.asarray([s.pos if s._joined else 0 for s in rows], jnp.int32)
+        active = jnp.asarray([s._joined for s in rows], bool)
+        temps = jnp.asarray(
+            [s._temperature if s._joined else 1.0 for s in rows], jnp.float32
+        )
+        topps = jnp.asarray(
+            [s._topp if s._joined else 0.9 for s in rows], jnp.float32
+        )
+        keys = jnp.stack(
+            [s._key if s._joined and s._key is not None else zero_key for s in rows]
+        )
+        sw = Stopwatch()
+        with engine._depth_lock:
+            engine._pipeline_depth += 1  # released when the fetch drains
+        try:
+            with engine._tel.span(
+                "batch_decode_chunk", bucket=bucket, active=len(joined),
+                steps=self.chunk,
+            ):
+                if engine._tp_engine is None:
+                    from distributed_llama_tpu.models import sampling
+
+                    tokens, self._slab, new_keys = sampling.decode_chunk_batched(
+                        engine.cfg, engine.params, first, self._slab, pos, active,
+                        self.chunk, temps, topps, keys,
+                    )
+                else:
+                    tokens, self._slab, new_keys = engine._tp_engine.batched_decode_chunk(
+                        engine.params, first, self._slab, pos, active,
+                        self.chunk, temps, topps, keys,
+                    )
+        except BaseException:
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+            raise
+        for s in joined:
+            # the next chunk seeds from this chunk's last token and advanced
+            # key — both stay device-resident (no fetch on the critical path)
+            s._first = tokens[-1, s.row]
+            s._key = new_keys[s.row]
+            s.pos += self.chunk
+        if engine._tel.enabled:
+            engine._tel.batch_occupancy.set(len(joined) / bucket)
+        self._pending = (
+            tokens, [(s, s._epoch) for s in joined], bucket, len(joined), sw,
+        )
+
+    def _fetch(self, pend) -> None:
+        """Blocking fetch of a dispatched chunk (no scheduler lock held);
+        delivers each joined row's column into its stream queue. The epoch
+        check keeps a late fetch from feeding a row's NEXT occupant."""
+        engine = self.engine
+        tokens_dev, snapshot, bucket, n_active, sw = pend
+        toks = None
+        error: BaseException | None = None
+        try:
+            try:
+                tokens_dev.copy_to_host_async()
+            except Exception:
+                pass  # optional acceleration; np.asarray below is the contract
+            with engine._tel.span("batch_decode_fetch", bucket=bucket):
+                toks = np.asarray(tokens_dev)  # [chunk, bucket]
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+            per_token_ms = sw.elapsed_ms() / self.chunk
+            # the I/T split may trigger a transfer re-measurement (a device
+            # round trip under TP) — run it BEFORE taking the scheduler
+            # lock so a probe never blocks every lane's join/dispatch
+            entry = engine._split_stats(per_token_ms)
+            tel = engine._tel
+            with self._cond:
+                self._fetching = False
+                for s, epoch in snapshot:
+                    if s._joined and s._epoch == epoch:
+                        if toks is not None:
+                            s._queue.extend(int(t) for t in toks[:, s.row])
+                            s.stats.extend([entry] * self.chunk)
+                            if tel.enabled:
+                                tel.kv_occupancy.set(
+                                    min(s.pos / engine.cfg.seq_len, 1.0)
+                                )
+                        else:
+                            # the chunk's tokens are lost but every row's
+                            # position already advanced at dispatch:
+                            # poison the co-batched streams so their
+                            # requests FAIL instead of emitting a silent
+                            # token hole
+                            s._fetch_error = error
+                self._cond.notify_all()
+        tel = engine._tel
+        if tel.enabled:
+            tel.tokens_generated.inc(self.chunk * n_active)
+            tel.decode_latency.observe(per_token_ms / 1000.0)
+        # a chunk kicked WHILE this fetch was in flight may already be
+        # orphaned (its kicker stopped at the fused first token and its
+        # _leave-time drain skipped because _fetching was still true):
+        # re-check the idle-drain condition now that the fetch is done —
+        # the one-pending-slot invariant bounds the recursion. A fetch that
+        # RAISED skips this, but the failing request's own _leave drains.
+        self._drain_if_idle()

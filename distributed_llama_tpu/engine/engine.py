@@ -116,12 +116,19 @@ class EngineStream:
 
     def reset(self) -> None:
         self.pos = 0
+        # the engine's transfer-refresh cadence counts tokens across ALL
+        # streams and its last measurement stays valid — one stream's reset
+        # must be a NO-OP on the shared cadence (zeroing the engine-wide
+        # watermark forced an early re-measurement for every stream under
+        # concurrent serving, ADVICE r5). Clearing this stream's stats
+        # shrinks the engine-wide token sum, so the watermark shifts down
+        # by the same amount to keep (total - watermark) unchanged.
+        cleared = sum(s.n_tokens for s in self.stats)
+        with self.engine._depth_lock:
+            self.engine._transfer_measured_at -= cleared
         self.stats.clear()
         self._release_depth()  # an abandoned un-fetched prefill must not pin the depth
         self._pending_prefill_entry = None
-        # keep the engine's last transfer measurement (still valid) but
-        # restart the refresh cadence with the cleared token count
-        self.engine._transfer_measured_at = 0
 
     def rollback(self, pos: int) -> None:
         """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots
@@ -154,9 +161,18 @@ class EngineStream:
                 bucket = n  # exact-length compile near the context limit
             padded = np.zeros(bucket, dtype=np.int32)
             padded[:n] = tokens
-        logits, self.cache = engine._forward(
-            engine.params, jnp.asarray(padded), self.cache, jnp.int32(self.pos)
-        )
+        if engine._forward_takes_n_real:
+            # the real-token count rides into the jitted forward (traced, no
+            # recompile) so the capacity-bucketed MoE prefill can keep
+            # bucket-pad rows out of its per-expert buckets
+            logits, self.cache = engine._forward(
+                engine.params, jnp.asarray(padded), self.cache,
+                jnp.int32(self.pos), jnp.int32(n),
+            )
+        else:
+            logits, self.cache = engine._forward(
+                engine.params, jnp.asarray(padded), self.cache, jnp.int32(self.pos)
+            )
         self.pos += n
         return logits
 
@@ -675,8 +691,18 @@ class InferenceEngine:
         else:
             self.params = jax.device_put(host_params)
             self._forward = functools.partial(self._forward_single, self.cfg)
+        # whether the forward accepts the real-token count of a bucket-padded
+        # prompt (the capacity-bucketed MoE prefill's pad mask): the
+        # single-chip path always does; backends opt in via the attribute
+        self._forward_takes_n_real = self._tp_engine is None or getattr(
+            self._tp_engine, "accepts_n_real", False
+        )
         self._streams: list[EngineStream] = []
-        self._default = EngineStream(self, self._new_cache())
+        # the classic single-stream surface's stream is created LAZILY on
+        # first use: batched serving (engine.batch) never touches it, and
+        # eagerly allocating its KV cache would hold one full cache of HBM
+        # dead next to the scheduler's slab
+        self._default: EngineStream | None = None
         self._transfer_ms: float | None = None  # measured lazily under TP/SP
         self._transfer_measured_at = 0  # token count at the last measurement
         self._pipeline_depth = 0  # >0 while a speculative chunk is in flight
@@ -700,6 +726,8 @@ class InferenceEngine:
 
     @property
     def default_stream(self) -> EngineStream:
+        if self._default is None:
+            self._default = EngineStream(self, self._new_cache())
         return self._default
 
     # ------------------------------------------------------------------
@@ -708,62 +736,62 @@ class InferenceEngine:
 
     @property
     def pos(self) -> int:
-        return self._default.pos
+        return self.default_stream.pos
 
     @pos.setter
     def pos(self, value: int) -> None:
-        self._default.pos = value
+        self.default_stream.pos = value
 
     @property
     def cache(self):
-        return self._default.cache
+        return self.default_stream.cache
 
     @cache.setter
     def cache(self, value) -> None:
-        self._default.cache = value
+        self.default_stream.cache = value
 
     @property
     def stats(self) -> list[TokenStats]:
-        return self._default.stats
+        return self.default_stream.stats
 
     def reset(self) -> None:
-        self._default.reset()
+        self.default_stream.reset()
 
     def rollback(self, pos: int) -> None:
-        self._default.rollback(pos)
+        self.default_stream.rollback(pos)
 
     def forward(self, tokens) -> np.ndarray:
-        return self._default.forward(tokens)
+        return self.default_stream.forward(tokens)
 
     def prefill(self, tokens) -> np.ndarray:
-        return self._default.prefill(tokens)
+        return self.default_stream.prefill(tokens)
 
     def prefill_device(self, tokens, temperature, topp, seed: int):
-        return self._default.prefill_device(tokens, temperature, topp, seed)
+        return self.default_stream.prefill_device(tokens, temperature, topp, seed)
 
     def decode_step(self, token: int) -> np.ndarray:
-        return self._default.decode_step(token)
+        return self.default_stream.decode_step(token)
 
     def fetch_first_token(self, first_token) -> int:
-        return self._default.fetch_first_token(first_token)
+        return self.default_stream.fetch_first_token(first_token)
 
     def generate_on_device(self, *args, **kwargs) -> np.ndarray:
-        return self._default.generate_on_device(*args, **kwargs)
+        return self.default_stream.generate_on_device(*args, **kwargs)
 
     def decode_chunk(self, *args, **kwargs):
-        return self._default.decode_chunk(*args, **kwargs)
+        return self.default_stream.decode_chunk(*args, **kwargs)
 
     def generate_chunks(self, *args, **kwargs):
-        return self._default.generate_chunks(*args, **kwargs)
+        return self.default_stream.generate_chunks(*args, **kwargs)
 
     def stream_decode(self, *args, **kwargs) -> int:
-        return self._default.stream_decode(*args, **kwargs)
+        return self.default_stream.stream_decode(*args, **kwargs)
 
     def avg_stats(self) -> TokenStats:
-        return self._default.avg_stats()
+        return self.default_stream.avg_stats()
 
     def total_tokens(self) -> int:
-        return self._default.total_tokens()
+        return self.default_stream.total_tokens()
 
     # ------------------------------------------------------------------
     # Shared internals
@@ -859,8 +887,8 @@ class InferenceEngine:
 
     @staticmethod
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
-    def _forward_single(cfg: LlamaConfig, params, tokens, cache, pos):
-        return llama.forward_tokens(cfg, params, tokens, cache, pos)
+    def _forward_single(cfg: LlamaConfig, params, tokens, cache, pos, n_real=None):
+        return llama.forward_tokens(cfg, params, tokens, cache, pos, n_real=n_real)
 
 
 @jax.jit

@@ -127,12 +127,15 @@ def block_tail(
     lp: Params,
     axis_name: str | None,
     ep_axis: str | None = None,
+    n_real: jax.Array | None = None,
 ) -> jax.Array:
     """Everything after the attention mix: wo projection (+psum under TP),
     the arch-dependent residual/norm placement, and the FFN/MoE half.
     ``att``: [T, Hl*hd]. ``ep_axis``: expert-parallel mesh axis — expert
     banks are sharded over it and the MoE FFN runs the dispatch/combine
-    exchange (parallel.expert_parallel)."""
+    exchange (parallel.expert_parallel). ``n_real``: number of REAL rows in
+    a bucket-padded batch (rows >= n_real are engine pad zeros) — the
+    capacity-bucketed MoE prefill masks pads out of its expert buckets."""
     out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
     if axis_name is not None:
         # the TP all-reduce: replaces gather + merge-add on root
@@ -147,7 +150,7 @@ def block_tail(
     if cfg.is_moe:
         from distributed_llama_tpu.models import moe
 
-        x = moe.moe_block(cfg, x, lp, axis_name, ep_axis=ep_axis)
+        x = moe.moe_block(cfg, x, lp, axis_name, ep_axis=ep_axis, n_real=n_real)
     else:
         x = x + ffn(cfg, x, lp, axis_name).astype(x.dtype)
     return x
@@ -270,9 +273,13 @@ def block_forward(
     rope_rows: jax.Array,
     axis_name: str | None,
     ep_axis: str | None = None,
+    n_real: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     att, new_cache = attention(cfg, x, lp, cache_l, pos, rope_rows, axis_name)
-    return block_tail(cfg, x, att, lp, axis_name, ep_axis=ep_axis), new_cache
+    return (
+        block_tail(cfg, x, att, lp, axis_name, ep_axis=ep_axis, n_real=n_real),
+        new_cache,
+    )
 
 
 def forward_tokens(
@@ -283,6 +290,7 @@ def forward_tokens(
     pos: jax.Array,
     axis_name: str | None = None,
     ep_axis: str | None = None,
+    n_real: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Run T tokens through the model starting at absolute position ``pos``.
 
@@ -290,7 +298,9 @@ def forward_tokens(
     (the layered layout) or a stacked [L, 2, S, Kl, hd] array; returns
     (logits f32 [T, vocab], updated cache in the same form). The per-token
     path of the reference's Inference::infer (src/tasks.cpp:173-184) is the
-    T=1 case.
+    T=1 case. ``n_real``: real (non-pad) token count of a bucket-padded
+    prompt — only the capacity-bucketed MoE prefill consumes it (pad rows
+    must not spend per-expert bucket capacity); None = all rows real.
     """
     T = tokens.shape[0]
     x = embed(cfg, params, tokens)
@@ -310,7 +320,8 @@ def forward_tokens(
         new_layers = []
         for l, lp in enumerate(params["layers"]):
             x, nc = block_forward(
-                cfg, x, lp, cache[l], pos, rope_rows, axis_name, ep_axis=ep_axis
+                cfg, x, lp, cache[l], pos, rope_rows, axis_name, ep_axis=ep_axis,
+                n_real=n_real,
             )
             new_layers.append(nc)
         new_cache = type(cache)(new_layers) if cache_is_list else jnp.stack(new_layers)
@@ -320,13 +331,129 @@ def forward_tokens(
             xc = carry
             lp, cache_l = scanned
             xc, new_cache_l = block_forward(
-                cfg, xc, lp, cache_l, pos, rope_rows, axis_name, ep_axis=ep_axis
+                cfg, xc, lp, cache_l, pos, rope_rows, axis_name, ep_axis=ep_axis,
+                n_real=n_real,
             )
             return xc, new_cache_l
 
         x, new_cache = jax.lax.scan(body, x, (params["layers"], cache))
 
     return final_logits(cfg, params, x), new_cache
+
+
+def attention_batched(
+    cfg: LlamaConfig,
+    x: jax.Array,  # [B, dim] — one token per independent sequence
+    lp: Params,
+    cache_l,  # (keys, values) slab halves [B, S, Kl, hd]
+    pos: jax.Array,  # [B] per-row absolute positions
+    rope_rows: jax.Array,  # [B, hd/2, 2] per-row rope table rows
+    active: jax.Array,  # [B] bool — False rows decode garbage, write nothing
+) -> tuple[jax.Array, jax.Array]:
+    """One decode step of B INDEPENDENT sequences over a slab cache with a
+    leading batch axis: row ``b`` writes its K/V at its own ``pos[b]`` and
+    attends over its own cache row masked by ``pos[b]``. Everything outside
+    attention (norms, matmuls, FFN) is position-free, so the batch shares
+    one weight read per matrix per step — the whole point of batching an
+    HBM-bound decode. Inactive rows write at a DROPPED out-of-bounds slot
+    (retired caches stay byte-identical for prefix reuse) and their outputs
+    are garbage the scheduler discards."""
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
+    B = x.shape[0]
+    S = cache_l[0].shape[1]
+    hd = cfg.head_size
+    q, k, v = project_qkv(cfg, lp, x, rope_rows)  # [B, Hl, hd], [B, Kl, hd] x2
+    Hl, Kl = q.shape[1], k.shape[1]
+
+    write_slot = jnp.where(active & (pos < S), pos, S)  # S = dropped
+    keys = kvc.update_row_batched(cache_l[0], k, write_slot)
+    values = kvc.update_row_batched(cache_l[1], v, write_slot)
+    new_cache = (keys, values)
+
+    kv_mul = Hl // Kl
+    cdt = kvc.compute_dtype(keys)
+    prec = kvc.einsum_precision(keys)
+    qg = q.reshape(B, Kl, kv_mul, hd).astype(cdt)
+    # inactive rows read from position 0 so they cannot inflate the shared
+    # dynamic chunk bound (their output is garbage either way)
+    read_pos = jnp.where(active, pos, 0)
+    if S % ATT_CHUNK == 0 and S > ATT_CHUNK:
+        from distributed_llama_tpu.ops.attention import batched_decode_attention
+
+        att = batched_decode_attention(
+            qg.astype(jnp.float32), keys, values, read_pos, ATT_CHUNK
+        ).astype(jnp.float32)
+        return att.reshape(B, Hl * hd), new_cache
+    # a dispatch bucket below B_max reads only its own slab rows
+    keys_b = keys if keys.shape[0] == B else kvc.slice_rows_batched(keys, 0, S, rows=B)
+    values_b = (
+        values if values.shape[0] == B else kvc.slice_rows_batched(values, 0, S, rows=B)
+    )
+    scores = kvc.scores_einsum_batched(qg, keys_b, prec) / jnp.sqrt(jnp.float32(hd))
+    mask = jnp.arange(S)[None, :] <= read_pos[:, None]  # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    att = kvc.mix_einsum_batched(weights, values_b, cdt, prec).reshape(B, Hl * hd)
+    return att, new_cache
+
+
+def forward_step_batched(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,  # int32 [B]
+    cache,  # list of per-layer (keys, values) slab tuples [B, S, Kl, hd]
+    pos: jax.Array,  # int32 [B] per-row positions
+    active: jax.Array,  # bool [B]
+    axis_name: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """One batched decode step: B tokens (one per sequence) at per-row
+    positions through the whole model, reading each weight matrix ONCE.
+    Returns (logits f32 [B, vocab], updated slab cache). Requires the
+    layered (per-layer list) cache layout — the only engine layout; a
+    stacked slab would copy itself every step (see forward_tokens).
+
+    MoE note: with B > 1 the FFN takes the DENSE expert path (every expert
+    computed, zero-weighted ones contributing exact zeros), not the T==1
+    top-k switch — per-step expert HBM reads are E shared across B rows vs
+    B·k for B separate streams, so batching still wins once B ≥ E/k
+    (break-even at B=4 for Mixtral's 2-of-8). Per-row outputs match
+    single-stream decode up to expert-sum reordering (the dense mix adds
+    experts in bank order, the switch in top-k order); the BIT-parity
+    contract of the batched path is exact for dense models only."""
+    if not isinstance(cache, (list, tuple)):
+        raise ValueError("batched decode requires the layered (per-layer list) cache")
+    x = embed(cfg, params, tokens)  # [B, dim]
+    rope_rows = params["rope_table"][jnp.clip(pos, 0, cfg.seq_len - 1)]
+    layers = params["layers"]
+    if not isinstance(layers, (list, tuple)):
+        raise ValueError("batched decode requires the per-layer-list params layout")
+    new_layers = []
+    for l, lp in enumerate(layers):
+        att, nc = attention_batched(cfg, x, lp, cache[l], pos, rope_rows, active)
+        x = block_tail(cfg, x, att, lp, axis_name)
+        new_layers.append(nc)
+    return final_logits(cfg, params, x), type(cache)(new_layers)
+
+
+def init_batch_cache(
+    cfg: LlamaConfig,
+    b_max: int,
+    n_kv_heads_local: int | None = None,
+    dtype=jnp.float32,
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Slab KV cache for ``b_max`` concurrent decode streams: a list of
+    per-layer ``(keys, values)`` tuples of [b_max, S, Kl, hd] halves (the
+    layered layout with a leading batch axis; i8 slabs quantize per
+    (row, slot, head) exactly like the single-stream i8 cache)."""
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
+    kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
+    shape = (b_max, cfg.seq_len, kl, cfg.head_size)
+    return [
+        (kvc.init_half(shape, dtype), kvc.init_half(shape, dtype))
+        for _ in range(cfg.n_layers)
+    ]
 
 
 def init_cache(

@@ -141,11 +141,14 @@ def bucket_scatter(
 ) -> jax.Array:
     """Gather each expert's routed rows into fixed [n_buckets, C, D]
     buckets; rows ranked past C land in a spill row that is trimmed
-    (capacity drop)."""
+    (capacity drop). An expert index >= n_buckets drops the row entirely
+    (the pad-token sink of the bucketed prefill)."""
     D = x.shape[-1]
     slot = jnp.where(rank < C, rank, C)
     return (
-        jnp.zeros((n_buckets, C + 1, D), x.dtype).at[flat_e, slot].set(x[t_ids])
+        jnp.zeros((n_buckets, C + 1, D), x.dtype)
+        .at[flat_e, slot]
+        .set(x[t_ids], mode="drop")
     )[:, :C]
 
 
@@ -165,17 +168,21 @@ def bucket_combine(
     return jnp.einsum("tk,tkd->td", top_vals * valid, gathered)
 
 
-def _moe_dense(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
+def _moe_dense(
+    cfg: LlamaConfig, xn: jax.Array, lp, n_real: jax.Array | None = None
+) -> jax.Array:
     """Prefill path: every expert computed, mixed by the mostly-zero [T, E]
     weight matrix. For stacked bf16 banks this is one batched einsum; for
     per-expert q40 leaves: serial all-E by default (exact), or — with an
     opted-in capacity factor (cfg.moe_capacity_factor, the --moe-capacity
     flag) — gather-to-expert-buckets + per-expert batched fused matmuls
     (each expert computes only ~factor·T·k/E rows instead of all T, at the
-    cost of capacity drops under routing imbalance)."""
+    cost of capacity drops under routing imbalance). ``n_real`` marks the
+    real-token prefix of a bucket-padded batch; the bucketed path masks the
+    pad rows out of its expert buckets (they must not spend capacity)."""
     if "experts" in lp:
         if cfg.moe_capacity_factor > 0 and xn.shape[0] >= MOE_BUCKETED_MIN_T:
-            return _moe_dense_bucketed(cfg, xn, lp)
+            return _moe_dense_bucketed(cfg, xn, lp, n_real=n_real)
         weights = router_weights(cfg, xn, lp["router"])  # [T, E] f32
         out = jnp.zeros(xn.shape, jnp.float32)
         for e in range(cfg.n_experts):
@@ -210,7 +217,9 @@ def _moe_dense(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
     return jnp.einsum("te,ted->td", weights, down, precision=jax.lax.Precision.HIGHEST)
 
 
-def _moe_dense_bucketed(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
+def _moe_dense_bucketed(
+    cfg: LlamaConfig, xn: jax.Array, lp, n_real: jax.Array | None = None
+) -> jax.Array:
     """Capacity-bucketed q40 prefill: rank every (token, choice) within its
     expert, gather each expert's rows into a fixed [C, D] bucket, run ONE
     fused q40 FFN per expert over its bucket, and combine outputs with the
@@ -219,11 +228,21 @@ def _moe_dense_bucketed(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
     +15% prefill at T=128, docs/PERF.md); the expert-weight HBM reads are
     identical, so the win scales with T. The bucket algebra
     (bucket_rank/scatter/combine) is shared with the expert-parallel
-    dispatch (parallel.expert_parallel._ep_dispatch)."""
+    dispatch (parallel.expert_parallel._ep_dispatch).
+
+    Engine bucket-padding appends zero tokens past ``n_real``; those rows
+    route like real tokens (identical embeddings → identical experts), so
+    unmasked they would pile into a few experts' buckets. They are routed
+    to a sink index E instead: the one-hot rank treats them as absent and
+    the scatter drops them, so capacity is spent ONLY on real tokens (the
+    capacity C itself must stay a static function of the padded T)."""
     T, D = xn.shape
     E = cfg.n_experts
     k = cfg.n_active_experts
     top_vals, top_idx = router_topk(cfg, xn, lp["router"])  # [T, k]
+    if n_real is not None:
+        valid = jnp.arange(T) < n_real
+        top_idx = jnp.where(valid[:, None], top_idx, E)  # sink: pads drop
 
     C = bucket_capacity(cfg.moe_capacity_factor, T, k, E)
     flat_e, rank, t_ids = bucket_rank(top_idx, E)
@@ -237,14 +256,15 @@ def _moe_dense_bucketed(cfg: LlamaConfig, xn: jax.Array, lp) -> jax.Array:
 
 def moe_ffn(
     cfg: LlamaConfig, xn: jax.Array, lp, axis_name: str | None,
-    ep_axis: str | None = None,
+    ep_axis: str | None = None, n_real: jax.Array | None = None,
 ) -> jax.Array:
     """Expert-mixed SwiGLU. ``xn``: [T, dim] (already normed); returns
     [T, dim] (psum'd over TP shards). With ``ep_axis`` set the expert banks
     in ``lp`` are SHARDED over that mesh axis (device owns E/ep whole
     experts) and the exchange runs in parallel.expert_parallel — the psum
     over ``axis_name`` (hidden-slice partial sums under TP) still applies on
-    top."""
+    top. ``n_real`` (bucket-padded prefill) reaches only the capacity-
+    bucketed dense path; the exact paths compute pads harmlessly."""
     if ep_axis is not None:
         from distributed_llama_tpu.parallel.expert_parallel import ep_moe_ffn
 
@@ -252,7 +272,7 @@ def moe_ffn(
     elif xn.shape[0] == 1:
         out = _moe_topk(cfg, xn, lp)
     else:
-        out = _moe_dense(cfg, xn, lp)
+        out = _moe_dense(cfg, xn, lp, n_real=n_real)
     if axis_name is not None:
         out = jax.lax.psum(out, axis_name)
     return out
@@ -260,7 +280,7 @@ def moe_ffn(
 
 def moe_block(
     cfg: LlamaConfig, x: jax.Array, lp, axis_name: str | None,
-    ep_axis: str | None = None,
+    ep_axis: str | None = None, n_real: jax.Array | None = None,
 ) -> jax.Array:
     """The FFN half of a MoE block, *after* the attention residual has been
     applied by the caller. Handles the Mixtral-vs-Grok norm placement."""
@@ -268,7 +288,9 @@ def moe_block(
 
     if cfg.arch == ArchType.GROK1:
         xn = rmsnorm(x, lp["rms_moe"])
-        out = moe_ffn(cfg, xn, lp, axis_name, ep_axis=ep_axis)
+        out = moe_ffn(cfg, xn, lp, axis_name, ep_axis=ep_axis, n_real=n_real)
         return x + rmsnorm(out.astype(x.dtype), lp["rms_ffn2"])
     xn = rmsnorm(x, lp["rms_ffn"])
-    return x + moe_ffn(cfg, xn, lp, axis_name, ep_axis=ep_axis).astype(x.dtype)
+    return x + moe_ffn(
+        cfg, xn, lp, axis_name, ep_axis=ep_axis, n_real=n_real
+    ).astype(x.dtype)

@@ -164,6 +164,88 @@ def decode_loop(
     return tokens, cache
 
 
+def sample_tokens_batched(
+    logits: jax.Array,  # [B, vocab] f32
+    keys: jax.Array,  # [B, 2] per-row PRNG keys
+    temperature: jax.Array,  # [B]
+    topp: jax.Array,  # [B]
+) -> jax.Array:
+    """Per-row sampling with per-row keys/settings: a vmap of the dynamic
+    single-row sampler, so row ``b`` draws EXACTLY what a single-stream
+    chunk with the same key would (vmap preserves per-row semantics — the
+    bit-parity contract of the batched decode)."""
+    return jax.vmap(_sample_token_dynamic)(logits, keys, temperature, topp)
+
+
+def batched_decode_scan(
+    cfg: LlamaConfig,
+    params,
+    first_tokens: jax.Array,  # int32 [B]
+    cache,  # slab cache (llama.init_batch_cache)
+    pos: jax.Array,  # int32 [B] per-row positions of first_tokens
+    active: jax.Array,  # bool [B]
+    keys: jax.Array,  # [B, 2] per-row PRNG keys
+    n_steps: int,
+    temperature: jax.Array,  # [B]
+    topp: jax.Array,  # [B]
+    axis_name: str | None = None,
+):
+    """The batched decode body: B sequences step together, each weight
+    matrix read once per step. Per row it is the same forward → split →
+    sample → feed-back chain as :func:`decode_scan`, with the SAME
+    key-splitting order, so a row's token stream is identical to the
+    single-stream chunked decode for the same per-row key. Inactive rows
+    compute garbage (masked out of cache writes and position advances) so
+    requests can join/leave between chunks without a recompile. Returns
+    (tokens [n_steps, B], cache, advanced keys [B, 2])."""
+
+    def step(carry, _):
+        tokens, cache_c, p, ks = carry
+        logits, cache_c = llama.forward_step_batched(
+            cfg, params, tokens, cache_c, p, active, axis_name=axis_name
+        )
+        if axis_name is not None and logits.shape[-1] != cfg.vocab_size:
+            logits = jax.lax.all_gather(logits, axis_name, axis=1, tiled=True)
+        split = jax.vmap(jax.random.split)(ks)  # [B, 2, 2]
+        ks2, subs = split[:, 0], split[:, 1]
+        nxt = sample_tokens_batched(logits, subs, temperature, topp)
+        p2 = jnp.where(active, p + 1, p)
+        return (nxt.astype(jnp.int32), cache_c, p2, ks2), nxt
+
+    (_, cache, _, keys), tokens = jax.lax.scan(
+        step,
+        (first_tokens.astype(jnp.int32), cache, pos.astype(jnp.int32), keys),
+        None,
+        length=n_steps,
+    )
+    return tokens, cache, keys
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(3,))
+def decode_chunk_batched(
+    cfg: LlamaConfig,
+    params,
+    first_tokens: jax.Array,
+    cache,
+    pos: jax.Array,
+    active: jax.Array,
+    n_steps: int,
+    temperature: jax.Array,
+    topp: jax.Array,
+    keys: jax.Array,
+):
+    """One chunk of the batched multi-stream decode (single chip): like
+    :func:`decode_chunk` but over B concurrent sequences with per-row
+    positions, sampler settings and PRNG keys — one compiled program per
+    (bucket, chunk) shape serves every mix of requests. The slab cache is
+    donated and aliases in place; advanced per-row keys return so each
+    stream continues exactly as its single-stream chunked decode would."""
+    return batched_decode_scan(
+        cfg, params, first_tokens, cache, pos, active, keys, n_steps,
+        temperature, topp,
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
 def decode_chunk(
     cfg: LlamaConfig,

@@ -98,6 +98,56 @@ def blocked_partials(
     return jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
 
 
+def batched_decode_attention(
+    qg: jax.Array,  # [B, K, M, hd] f32 grouped queries (one token per row)
+    keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
+    values,
+    pos: jax.Array,  # [B] per-row absolute positions (inactive rows: 0)
+    chunk: int,
+) -> jax.Array:
+    """Blocked causal attention of B independent single-token queries, each
+    over its OWN slab cache row, masked by its OWN position: row ``b`` sees
+    slots 0..pos[b]. One fori_loop covers all rows with a shared DYNAMIC
+    chunk bound (max over pos), so slots beyond the longest live context are
+    never read; rows shorter than the bound are masked per chunk and fully-
+    masked chunks contribute zero via the online-softmax merge. Returns
+    [B, K, M, hd] f32. Requires S % chunk == 0 (callers fall back to the
+    full-S einsum otherwise, exactly like the single-stream path). The
+    slab may hold MORE rows than B (a dispatch bucket below B_max): only
+    the first B rows are read."""
+    B, K, M, hd = qg.shape
+    S = keys.shape[1]
+    cdt = kvc.compute_dtype(keys)
+    prec = kvc.einsum_precision(keys)
+    live = jnp.clip(jnp.max(pos) + 1, 0, S)
+    n_chunks = jax.lax.div(live + chunk - 1, chunk)
+
+    def body(i, carry):
+        m, l, o = carry
+        start = i * chunk
+        kc = kvc.slice_rows_batched(keys, start, chunk, rows=B)
+        vc = kvc.slice_rows_batched(values, start, chunk, rows=B)
+        k_pos = start + jnp.arange(chunk)
+        scores = kvc.scores_einsum_batched(qg.astype(cdt), kc, prec) / jnp.sqrt(
+            jnp.float32(hd)
+        )  # [B, K, M, chunk]
+        mask = (k_pos[None, :] <= pos[:, None])[:, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        ms = jnp.max(scores, axis=-1)
+        safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(mask, p, 0.0)
+        ls = jnp.sum(p, axis=-1)
+        os_ = kvc.mix_einsum_batched(p, vc, cdt, prec)
+        return merge_partials(m, l, o, safe_m, ls, os_)
+
+    m0 = jnp.full((B, K, M), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, K, M), jnp.float32)
+    o0 = jnp.zeros((B, K, M, hd), jnp.float32)
+    m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
 def blocked_attention(
     qg: jax.Array,  # [T, K, M, hd] f32 grouped queries
     keys,  # cache half [S, K, hd] (array or QuantizedKV)

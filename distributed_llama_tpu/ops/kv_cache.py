@@ -146,6 +146,72 @@ def slice_rows(half, start, n: int):
     return jax.lax.dynamic_slice(half, (start, 0, 0), (n, K, hd))
 
 
+# ---------------------------------------------------------------------------
+# Batched slab cache (engine.batch): one [B, S, K, hd] slab per half per
+# layer serves B concurrent decode streams — the leading batch axis is the
+# ONLY layout difference from the single-stream [S, K, hd] half, so every
+# dtype (bf16/f32 arrays, i8 QuantizedKV) batches with the same pytree
+# shape rules (scales gain the batch axis too: [B, S, K, 1]).
+# ---------------------------------------------------------------------------
+
+
+def update_row_batched(half, rows: jax.Array, slot: jax.Array):
+    """Per-row single-slot write of the batched decode step: row ``b`` of
+    ``rows`` [B, K, hd] lands at cache slot ``slot[b]`` of slab row ``b``.
+    A slot index >= S DROPS the write — the batch scheduler retires a
+    stream by pointing its slot out of bounds, so an inactive row's garbage
+    decode never touches the retired cache (its prefix stays reusable)."""
+    b_idx = jnp.arange(rows.shape[0])
+    if isinstance(half, QuantizedKV):
+        q, s = quantize_rows(rows)
+        return QuantizedKV(
+            half.data.at[b_idx, slot].set(q, mode="drop"),
+            half.scales.at[b_idx, slot].set(s, mode="drop"),
+        )
+    return half.at[b_idx, slot].set(rows.astype(half.dtype), mode="drop")
+
+
+def slice_rows_batched(half, start, n: int, rows: int | None = None):
+    """Read ``n`` slots [start, start+n) of the first ``rows`` slab rows
+    (the batched blocked-attention chunk read). ``start`` may be traced;
+    ``n``/``rows`` are static. ``rows`` defaults to every slab row — a
+    dispatch bucket smaller than the slab reads only its own rows."""
+    if isinstance(half, QuantizedKV):
+        B, S, K, hd = half.data.shape
+        b = B if rows is None else rows
+        return QuantizedKV(
+            jax.lax.dynamic_slice(half.data, (0, start, 0, 0), (b, n, K, hd)),
+            jax.lax.dynamic_slice(half.scales, (0, start, 0, 0), (b, n, K, 1)),
+        )
+    B, S, K, hd = half.shape
+    b = B if rows is None else rows
+    return jax.lax.dynamic_slice(half, (0, start, 0, 0), (b, n, K, hd))
+
+
+def slab_take_row(half, row):
+    """Extract slab row ``row`` as a single-stream [S, K, hd] cache half
+    (the slab prefill reuses the whole single-stream attention path on it)."""
+    if isinstance(half, QuantizedKV):
+        B, S, K, hd = half.data.shape
+        return QuantizedKV(
+            jax.lax.dynamic_slice(half.data, (row, 0, 0, 0), (1, S, K, hd))[0],
+            jax.lax.dynamic_slice(half.scales, (row, 0, 0, 0), (1, S, K, 1))[0],
+        )
+    B, S, K, hd = half.shape
+    return jax.lax.dynamic_slice(half, (row, 0, 0, 0), (1, S, K, hd))[0]
+
+
+def slab_put_row(half, row_half, row):
+    """Write a single-stream cache half back into slab row ``row``. With the
+    slab donated, XLA aliases the untouched rows in place."""
+    if isinstance(half, QuantizedKV):
+        return QuantizedKV(
+            jax.lax.dynamic_update_slice(half.data, row_half.data[None], (row, 0, 0, 0)),
+            jax.lax.dynamic_update_slice(half.scales, row_half.scales[None], (row, 0, 0, 0)),
+        )
+    return jax.lax.dynamic_update_slice(half, row_half[None], (row, 0, 0, 0))
+
+
 def compute_dtype(half):
     """The einsum operand dtype for a cache half: the storage dtype for
     plain caches (bf16 reads stay bf16, f32 parity stays f32); bf16 for i8
@@ -191,5 +257,41 @@ def mix_einsum(weights: jax.Array, values, cdt, prec) -> jax.Array:
         )
     return jnp.einsum(
         "tkms,skh->tkmh", weights.astype(cdt), values, precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def scores_einsum_batched(qg: jax.Array, keys, prec) -> jax.Array:
+    """Batched-slab scores: row ``b`` of qg [B, K, M, hd] scores ONLY its
+    own cache row — scores[b,k,m,s] = q[b,k,m,:] . key_row[b,s,k,:]. Same
+    i8 scale-folding contract as :func:`scores_einsum`."""
+    if isinstance(keys, QuantizedKV):
+        raw = jnp.einsum(
+            "bkmh,bskh->bkms",
+            qg,
+            keys.data.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return raw * jnp.transpose(keys.scales[..., 0], (0, 2, 1))[:, :, None, :]
+    return jnp.einsum(
+        "bkmh,bskh->bkms", qg, keys, precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def mix_einsum_batched(weights: jax.Array, values, cdt, prec) -> jax.Array:
+    """Batched-slab value mix: att[b,k,m,h] = sum_s w[b,k,m,s] * v[b,s,k,h];
+    the i8 scale folds into the weights BEFORE the mix (the value read stays
+    int8), mirroring :func:`mix_einsum`."""
+    if isinstance(values, QuantizedKV):
+        wv = weights * jnp.transpose(values.scales[..., 0], (0, 2, 1))[:, :, None, :]
+        return jnp.einsum(
+            "bkms,bskh->bkmh",
+            wv.astype(cdt),
+            values.data.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "bkms,bskh->bkmh", weights.astype(cdt), values, precision=prec,
         preferred_element_type=jnp.float32,
     )

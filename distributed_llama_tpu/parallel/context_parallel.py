@@ -25,6 +25,8 @@ virtual CPU mesh (tests/test_context_parallel.py).
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
@@ -241,9 +243,14 @@ class SequenceParallelForward(TransferProbeMixin):
         # chunk width of the mid-context prefill: one dispatch consumes up
         # to this many tokens (padded to exactly this many)
         self.mid_prefill_chunk = 32
-        # dispatches issued by the most recent forward() call — the engine
-        # scales its measured per-dispatch transfer estimate by this
-        self.last_forward_dispatches = 1
+        # dispatches issued by the most recent forward() call ON THIS THREAD
+        # — the engine scales its measured per-dispatch transfer estimate by
+        # it. Thread-local: concurrent serving streams call forward() from
+        # their own request threads, and a shared counter would let stream
+        # A's chunked mid-prefill count leak into stream B's I/T stats split
+        # (ADVICE r5). Each thread reads back exactly what its own forward
+        # issued; threads that never forwarded read the 1-dispatch default.
+        self._dispatch_local = threading.local()
 
         prefill = shard_map(
             functools.partial(_sp_prefill, cfg, self._tp_axis),
@@ -273,6 +280,12 @@ class SequenceParallelForward(TransferProbeMixin):
         self._chunk_fwd = jax.jit(chunk_fwd, donate_argnums=(2,))
 
     # -- engine interface ---------------------------------------------------
+
+    @property
+    def last_forward_dispatches(self) -> int:
+        """Dispatch count of the calling thread's most recent forward()
+        (per-thread snapshot — see the ``_dispatch_local`` note)."""
+        return getattr(self._dispatch_local, "n", 1)
 
     def shard_params(self, host_params):
         from distributed_llama_tpu.parallel.tensor_parallel import place_params
@@ -316,7 +329,7 @@ class SequenceParallelForward(TransferProbeMixin):
         O(S) padded ring pass or one dispatch per token."""
         tokens = jnp.asarray(tokens)
         T = tokens.shape[0]
-        self.last_forward_dispatches = 1
+        self._dispatch_local.n = 1
         if T == 1:
             return self._step(params, tokens, cache, jnp.asarray(pos))
         S = self.cfg.seq_len
@@ -337,7 +350,7 @@ class SequenceParallelForward(TransferProbeMixin):
                 )
                 rows.append(logits[:c])
                 p += c
-            self.last_forward_dispatches = (T + CH - 1) // CH
+            self._dispatch_local.n = (T + CH - 1) // CH
             return jnp.concatenate(rows, axis=0), cache
         if T != S:
             tokens = jnp.pad(tokens, (0, S - tokens.shape[0]))

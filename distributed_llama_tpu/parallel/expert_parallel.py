@@ -127,6 +127,7 @@ def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
     follows cfg.moe_capacity_factor: 0 (default) = drop-free worst-case
     buckets (exact), >0 = standard capacity-drop semantics."""
     from distributed_llama_tpu.models.moe import (
+        MOE_BUCKETED_MIN_T,
         _expert_ffn,
         bucket_capacity,
         bucket_combine,
@@ -141,7 +142,13 @@ def _ep_dispatch(cfg, xn, lp, ep_axis: str, ep: int) -> jax.Array:
     k = cfg.n_active_experts
     Tl = T // ep
     idx = jax.lax.axis_index(ep_axis)
-    Ce = bucket_capacity(cfg.moe_capacity_factor, Tl, k, E)
+    # the dense path guards lossy capacity bucketing behind
+    # MOE_BUCKETED_MIN_T; apply the same guard per shard — below it the
+    # capacity estimate is noisy (drops bite hard at small Tl) and the
+    # exchange is expert-HBM-bound anyway, so fall back to the drop-free
+    # worst-case buckets (factor<=0 semantics: Ce = Tl, exact)
+    factor = cfg.moe_capacity_factor if Tl >= MOE_BUCKETED_MIN_T else 0.0
+    Ce = bucket_capacity(factor, Tl, k, E)
 
     x_local = jax.lax.dynamic_slice(xn, (idx * Tl, 0), (Tl, D))
     top_vals, top_idx = router_topk(cfg, x_local, lp["router"])  # [Tl, k]

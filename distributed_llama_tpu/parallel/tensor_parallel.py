@@ -161,6 +161,9 @@ def q40_param_specs(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[
 
 CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
 CACHE_SPEC_LAYER = P(None, "tp", None)  # per-layer (keys, values) tuples of [S, K, hd]
+# batched slab cache (engine.batch): per-layer (keys, values) tuples of
+# [B, S, K, hd] — batch and sequence replicated, KV heads sharded
+BATCH_CACHE_SPEC_LAYER = P(None, None, "tp", None)
 
 
 def place_params(host_params, specs, mesh) -> Any:
@@ -304,16 +307,20 @@ class TensorParallelForward(TransferProbeMixin):
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), self._cache_spec, P()),
+            in_specs=(self._specs, P(), self._cache_spec, P(), P()),
             out_specs=(P(), self._cache_spec),
             check_vma=False,
         )
         self._jitted = jax.jit(mapped, donate_argnums=(2,))
 
+    # the forward accepts the bucket-padded prompt's real-token count (the
+    # capacity-bucketed MoE prefill masks pad rows out of its buckets)
+    accepts_n_real = True
+
     @staticmethod
-    def _step(cfg, params, tokens, cache, pos):
+    def _step(cfg, params, tokens, cache, pos, n_real):
         logits, new_cache = llama.forward_tokens(
-            cfg, params, tokens, cache, pos, axis_name="tp"
+            cfg, params, tokens, cache, pos, axis_name="tp", n_real=n_real
         )
         if logits.shape[-1] != cfg.vocab_size:
             # wcls was vocab-sharded: reassemble full logits on every shard
@@ -559,5 +566,127 @@ class TensorParallelForward(TransferProbeMixin):
         zeros = np.zeros(per_shard, dtype)
         return jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
 
-    def forward(self, params, tokens, cache, pos):
-        return self._jitted(params, jnp.asarray(tokens), cache, jnp.asarray(pos))
+    def forward(self, params, tokens, cache, pos, n_real=None):
+        tokens = jnp.asarray(tokens)
+        if n_real is None:
+            n_real = tokens.shape[0]
+        return self._jitted(
+            params, tokens, cache, jnp.asarray(pos), jnp.int32(n_real)
+        )
+
+    # ------------------------------------------------------------------
+    # Batched multi-stream decode (engine.batch.BatchScheduler): the slab
+    # cache shards its KV-head axis over tp exactly like the per-stream
+    # caches, so the batched step is the same SPMD program family with a
+    # leading batch axis. Requires the layered params/cache layout (the
+    # engine's production layout for every dtype).
+    # ------------------------------------------------------------------
+
+    def init_batch_cache(self, b_max: int, dtype=jnp.float32):
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        if not self.layered:
+            raise ValueError("the batched slab cache requires the layered layout")
+        cfg = self.cfg
+        shape = (b_max, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
+        sharding = NamedSharding(self.mesh, BATCH_CACHE_SPEC_LAYER)
+
+        def zeros(gshape, dt):
+            local = np.zeros(gshape[:2] + (gshape[2] // self.tp,) + gshape[3:], dt)
+            return jax.make_array_from_callback(gshape, sharding, lambda idx: local)
+
+        return [
+            (kvc.init_half(shape, dtype, zeros=zeros),
+             kvc.init_half(shape, dtype, zeros=zeros))
+            for _ in range(cfg.n_layers)
+        ]
+
+    def _batched_chunk_jitted(self, n_steps: int):
+        key = ("batched_chunk", n_steps)
+        cached = self._chunk_cache.get(key)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.models import sampling
+
+        cfg = self.cfg
+        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+
+        def fn(params, first_tokens, cache, pos, active, temperature, topp, keys):
+            return sampling.batched_decode_scan(
+                cfg, params, first_tokens, cache, pos, active, keys, n_steps,
+                temperature, topp, axis_name="tp",
+            )
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), batch_cache_spec, P(), P(), P(), P(), P()),
+            out_specs=(P(), batch_cache_spec, P()),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._chunk_cache[key] = jitted
+        return jitted
+
+    def batched_decode_chunk(
+        self, params, first_tokens, cache, pos, active, n_steps, temperature,
+        topp, keys,
+    ):
+        """One chunk of the batched multi-stream decode under TP: B
+        sequences step together with per-row positions/keys/sampler
+        settings, collectives riding the mesh each step. One compiled
+        program per (bucket, chunk) shape."""
+        jitted = self._batched_chunk_jitted(int(n_steps))
+        return jitted(
+            params, jnp.asarray(first_tokens), cache, jnp.asarray(pos),
+            jnp.asarray(active), jnp.asarray(temperature), jnp.asarray(topp),
+            jnp.asarray(keys),
+        )
+
+    def _slab_forward_jitted(self):
+        key = ("slab_forward",)
+        cached = self._chunk_cache.get(key)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        cfg = self.cfg
+        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+
+        def fn(params, tokens, slab, row, pos, n_real):
+            row_cache = [
+                (kvc.slab_take_row(k, row), kvc.slab_take_row(v, row))
+                for k, v in slab
+            ]
+            logits, new_rows = llama.forward_tokens(
+                cfg, params, tokens, row_cache, pos, axis_name="tp",
+                n_real=n_real,
+            )
+            if logits.shape[-1] != cfg.vocab_size:
+                logits = jax.lax.all_gather(logits, "tp", axis=1, tiled=True)
+            new_slab = [
+                (kvc.slab_put_row(k, nk, row), kvc.slab_put_row(v, nv, row))
+                for (k, v), (nk, nv) in zip(slab, new_rows)
+            ]
+            return logits, new_slab
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), batch_cache_spec, P(), P(), P()),
+            out_specs=(P(), batch_cache_spec),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._chunk_cache[key] = jitted
+        return jitted
+
+    def slab_forward(self, params, tokens, slab, row: int, pos: int, n_real: int):
+        """Prefill ``tokens`` into slab row ``row`` under TP (the
+        per-request prefill of the batched serving path): the row runs the
+        ordinary sharded forward and is written back in place."""
+        jitted = self._slab_forward_jitted()
+        return jitted(
+            params, jnp.asarray(tokens), slab, jnp.int32(row), jnp.int32(pos),
+            jnp.int32(n_real),
+        )

@@ -242,6 +242,12 @@ class EngineInstruments:
             "dllama_engine_streams",
             "Engine streams constructed (each owns one KV cache of HBM)",
         )
+        self.batch_occupancy = gauge(
+            "dllama_batch_occupancy",
+            "Active rows / dispatched bucket rows of the most recent batched "
+            "decode chunk (0..1; 1.0 = every slab row in the bucket is a "
+            "live request sharing the step's weight reads)",
+        )
 
 
 class CollectiveInstruments:

@@ -160,6 +160,58 @@ class TestQ40Moe:
         ).forward(tokens)
         assert np.all(np.isfinite(out))
 
+    def test_q40_bucketed_prefill_pads_stay_out_of_buckets(self, tmp_path):
+        """Regression (ADVICE r5): engine bucket-padding appends zero tokens
+        that route like real tokens; the bucketed prefill must mask them
+        out so per-expert capacity is spent ONLY on real tokens. A padded
+        prompt (33 tokens → bucket 64) through a lossy-capacity engine must
+        reproduce the exact serial path on the real rows whenever the real
+        tokens fit the worst-case drop-free budget."""
+        spec = self._spec(seq_len=160)
+        tensors = random_tensors(spec, seed=6)
+        path = str(tmp_path / "moe_q40_pad.m")
+        write_model_file(path, spec, tensors)
+        tokens = list(np.random.RandomState(2).randint(1, spec.vocab_size, 33))
+
+        # factor sized so C(T_padded=64) >= 33: every real token fits even
+        # if all route to one expert — any real-row mismatch vs the exact
+        # serial path can only come from pads consuming bucket capacity
+        lossy = InferenceEngine(path, dtype="q40", moe_capacity_factor=3.0)
+        got = lossy.forward(tokens)  # engine pads 33 -> bucket 64
+        serial = InferenceEngine(path, dtype="q40").forward(tokens)
+        np.testing.assert_allclose(got, serial, rtol=2e-3, atol=2e-3)
+
+    def test_bucketed_pad_mask_routes_pads_to_sink(self):
+        """Unit-level: with n_real set, pad rows' expert indices become the
+        sink E, the one-hot rank ignores them, and the scatter drops them —
+        an expert bucket holds exactly the real routed rows."""
+        import jax.numpy as jnp_
+
+        from distributed_llama_tpu.models import moe
+
+        T, k, E, C, D = 8, 2, 4, 8, 6
+        rng = np.random.RandomState(0)
+        top_idx = jnp_.asarray(rng.randint(0, E, (T, k)))
+        x = jnp_.asarray(rng.randn(T, D).astype(np.float32))
+        n_real = 5
+        valid = jnp_.arange(T) < n_real
+        masked_idx = jnp_.where(valid[:, None], top_idx, E)
+
+        flat_e, rank, t_ids = moe.bucket_rank(masked_idx, E)
+        # pads contribute nothing to any expert's rank counters
+        import jax
+
+        counts = np.asarray(jnp_.sum(jax.nn.one_hot(flat_e, E), axis=0))
+        assert counts.sum() == n_real * k
+        buckets = moe.bucket_scatter(x, flat_e, rank, t_ids, E, C)
+        # every pad row's value is absent from every bucket slot
+        flat = np.asarray(buckets).reshape(-1, D)
+        for t in range(n_real, T):
+            assert not np.any(np.all(flat == np.asarray(x[t]), axis=-1))
+        # and every real routed row IS present
+        for t in range(n_real):
+            assert np.any(np.all(np.isclose(flat, np.asarray(x[t])), axis=-1))
+
     def test_q40_moe_tp_greedy_stream(self, tmp_path):
         """Q40 MoE under TP: per-expert sharded packs (gate|up out-sharded,
         down in-sharded) reproduce the single-device greedy stream."""
