@@ -53,11 +53,13 @@ from __future__ import annotations
 import collections
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
@@ -120,9 +122,14 @@ class BatchStream:
         self._topp = 0.9
         self._pending_prefill_entry: TokenStats | None = None
         self._depth_held = False
-        # a failed chunk fetch poisons every co-batched stream (their
-        # positions already advanced at dispatch — continuing would emit a
-        # silent token hole); next_token raises it instead
+        # per-request deadline (time.monotonic seconds) set by the serving
+        # layer: the scheduler retires an expired row BETWEEN chunks and its
+        # next_token raises DeadlineExceeded (ISSUE 3)
+        self.deadline: float | None = None
+        # a chunk failure retires ONLY this row (faults.RowQuarantined /
+        # StallTimeout / DeadlineExceeded, set by the scheduler under its
+        # lock); next_token raises it, surviving co-batched rows keep
+        # streaming — this replaces the seed's poison-every-stream behavior
         self._fetch_error: BaseException | None = None
 
     @property
@@ -151,6 +158,7 @@ class BatchStream:
         self._release_depth()
         self._pending_prefill_entry = None
         self._fetch_error = None
+        self.deadline = None
 
     def rollback(self, pos: int) -> None:
         """Rewind to ``pos`` (prefix-cache reuse / early-stop contract).
@@ -340,7 +348,15 @@ class BatchScheduler:
     tensor-parallel backends (the sp/ep backends keep their single-stream
     programs)."""
 
-    def __init__(self, engine, n_rows: int, chunk: int = 32):
+    def __init__(
+        self,
+        engine,
+        n_rows: int,
+        chunk: int = 32,
+        retries: int = 2,
+        retry_backoff_s: float = 0.05,
+        stall_timeout_s: float | None = None,
+    ):
         tp_engine = engine._tp_engine
         if tp_engine is not None and not hasattr(tp_engine, "batched_decode_chunk"):
             raise ValueError(
@@ -352,6 +368,14 @@ class BatchScheduler:
         self.engine = engine
         self.b_max = n_rows
         self.chunk = int(chunk)
+        # fault tolerance (ISSUE 3): bounded retry with exponential backoff
+        # for transient dispatch/fetch failures, an optional stall watchdog,
+        # and the bind-once fault-injection plan (NULL_PLAN when no chaos
+        # plan is installed — one no-op attribute call per dispatch)
+        self.retries = max(0, int(retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.stall_timeout_s = stall_timeout_s
+        self._faults = faults.active_plan()
         if tp_engine is None:
             self._slab = llama.init_batch_cache(
                 engine.cfg, n_rows, dtype=engine.cache_dtype
@@ -364,6 +388,70 @@ class BatchScheduler:
         # snapshot, bucket, active count, stopwatch)
         self._pending = None
         self._fetching = False
+        # fetch generation: bumped when a thread takes the pending chunk; the
+        # watchdog kills a stalled generation by flipping _fetching off, and
+        # the (eventually-returning) hung fetch sees its generation is dead
+        # and discards its delivery
+        self._fetch_gen = 0
+        self._fetch_started: float | None = None
+        self._shutdown = False
+        self._watchdog: threading.Thread | None = None
+        if stall_timeout_s is not None and stall_timeout_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="dllama-batch-watchdog",
+                daemon=True,
+            )
+            self._watchdog.start()
+
+    def close(self) -> None:
+        """Stop the watchdog thread (tests; a serving scheduler lives for
+        the process)."""
+        self._shutdown = True
+
+    def _watchdog_loop(self) -> None:
+        """Detect a hung chunk fetch and fail the batch CLEANLY: joined rows
+        get a typed StallTimeout (their requests end 500/504-class instead
+        of hanging forever), the dead fetch generation is retired so a late
+        completion delivers nothing, and the scheduler is immediately
+        serviceable for new requests."""
+        interval = max(min(self.stall_timeout_s / 4.0, 1.0), 0.005)
+        tel = self.engine._tel
+        while not self._shutdown:
+            time.sleep(interval)
+            with self._cond:
+                stalled = (
+                    self._fetching
+                    and self._fetch_started is not None
+                    and time.monotonic() - self._fetch_started > self.stall_timeout_s
+                )
+                if not stalled:
+                    continue
+                # take the hung fetch's completion duties: it can no longer
+                # claim ownership (_fetch claims under this lock), so ITS
+                # depth hold is released here — otherwise a never-returning
+                # fetch would pin pipeline_depth > 0 and freeze the transfer
+                # probe for the rest of the process
+                self._fetching = False
+                self._fetch_started = None
+                released = 1
+                if self._pending is not None:
+                    # drop the speculative chunk queued behind the hung
+                    # program: every row that wanted it is being retired, and
+                    # leaving it would make the LAST _leave's idle-drain
+                    # fetch it SYNCHRONOUSLY on a request thread — blocking
+                    # that client's error response behind the hang
+                    self._pending = None
+                    released += 1
+                with self.engine._depth_lock:
+                    self.engine._pipeline_depth -= released
+                for s in self._streams:
+                    if s._joined and s._fetch_error is None:
+                        s._fetch_error = faults.StallTimeout(
+                            "batched chunk fetch exceeded the "
+                            f"{self.stall_timeout_s:.1f}s stall timeout"
+                        )
+                tel.watchdog_stalls.inc()
+                self._cond.notify_all()
 
     def new_stream(self) -> BatchStream:
         """Hand out the next slab row as an EngineStream-like serving lane."""
@@ -439,8 +527,16 @@ class BatchScheduler:
         # probe treats the engine as permanently mid-flight
         self._drain_if_idle()
 
+    def _begin_fetch_locked(self) -> int:
+        """Mark a fetch in flight (cond lock held) and return its
+        generation — the token the watchdog invalidates on a stall."""
+        self._fetching = True
+        self._fetch_gen += 1
+        self._fetch_started = time.monotonic()
+        return self._fetch_gen
+
     def _drain_if_idle(self) -> None:
-        pend = None
+        pend = gen = None
         with self._cond:
             if (
                 self._pending is not None
@@ -449,9 +545,9 @@ class BatchScheduler:
             ):
                 pend = self._pending
                 self._pending = None
-                self._fetching = True
+                gen = self._begin_fetch_locked()
         if pend is not None:
-            self._fetch(pend)
+            self._fetch(pend, gen)
 
     def kick(self) -> None:
         """Dispatch a batched chunk now if none is in flight (used to start
@@ -467,17 +563,26 @@ class BatchScheduler:
 
     def next_token(self, stream: BatchStream) -> int:
         """Next decoded token for ``stream``; whichever thread runs dry
-        first dispatches/fetches the shared batched chunk for everyone."""
+        first dispatches/fetches the shared batched chunk for everyone.
+        Raises the stream's typed failure (RowQuarantined / StallTimeout)
+        when its row was retired, and DeadlineExceeded once the request's
+        deadline passes — the expired row leaves the batch between chunks
+        (stream_decode's finally) without touching its co-batched rows."""
         while True:
-            pend = None
+            pend = gen = None
             with self._cond:
                 if stream._fetch_error is not None:
                     err = stream._fetch_error
                     stream._fetch_error = None
-                    raise RuntimeError(
-                        "batched decode chunk fetch failed; this stream's "
-                        "tokens were lost"
-                    ) from err
+                    raise err
+                if (
+                    stream.deadline is not None
+                    and time.monotonic() >= stream.deadline
+                ):
+                    raise faults.DeadlineExceeded(
+                        f"request deadline expired mid-decode (row "
+                        f"{stream.row}); the row leaves the batch"
+                    )
                 if stream._queue:
                     return stream._queue.popleft()
                 if not stream._joined:
@@ -489,15 +594,18 @@ class BatchScheduler:
                     # speculative pipelining; at most ONE chunk runs ahead
                     # — the single pending slot bounds it)
                     self._dispatch_locked()
+                    if stream._fetch_error is not None:
+                        continue  # the dispatch retired this row: re-loop
+                        # raises the typed error without a wait cycle
                 if self._pending is not None and not self._fetching:
                     pend = self._pending
                     self._pending = None
-                    self._fetching = True
+                    gen = self._begin_fetch_locked()
                 else:
                     # another thread is mid-fetch: wait for its notify
                     self._cond.wait(timeout=0.1)
                     continue
-            self._fetch(pend)
+            self._fetch(pend, gen)
 
     def _dispatch_locked(self) -> None:
         """Build and dispatch one batched chunk from the joined streams
@@ -528,27 +636,65 @@ class BatchScheduler:
         sw = Stopwatch()
         with engine._depth_lock:
             engine._pipeline_depth += 1  # released when the fetch drains
+        tokens = new_keys = None
+        error: Exception | None = None
         try:
-            with engine._tel.span(
-                "batch_decode_chunk", bucket=bucket, active=len(joined),
-                steps=self.chunk,
-            ):
-                if engine._tp_engine is None:
-                    from distributed_llama_tpu.models import sampling
+            for attempt in range(self.retries + 1):
+                try:
+                    self._faults.fire("batch.dispatch")
+                    with engine._tel.span(
+                        "batch_decode_chunk", bucket=bucket, active=len(joined),
+                        steps=self.chunk,
+                    ):
+                        if engine._tp_engine is None:
+                            from distributed_llama_tpu.models import sampling
 
-                    tokens, self._slab, new_keys = sampling.decode_chunk_batched(
-                        engine.cfg, engine.params, first, self._slab, pos, active,
-                        self.chunk, temps, topps, keys,
-                    )
-                else:
-                    tokens, self._slab, new_keys = engine._tp_engine.batched_decode_chunk(
-                        engine.params, first, self._slab, pos, active,
-                        self.chunk, temps, topps, keys,
-                    )
+                            tokens, self._slab, new_keys = sampling.decode_chunk_batched(
+                                engine.cfg, engine.params, first, self._slab, pos,
+                                active, self.chunk, temps, topps, keys,
+                            )
+                        else:
+                            tokens, self._slab, new_keys = (
+                                engine._tp_engine.batched_decode_chunk(
+                                    engine.params, first, self._slab, pos, active,
+                                    self.chunk, temps, topps, keys,
+                                )
+                            )
+                    error = None
+                    break
+                except Exception as e:
+                    # transient failures (an injected dispatch raise, a flaky
+                    # runtime) retry with backoff — briefly blocking joins
+                    # (the cond lock is held) is the cost of a coherent
+                    # active set. Exception only: KeyboardInterrupt/
+                    # SystemExit must abort, not retry
+                    error = e
+                    if attempt < self.retries:
+                        engine._tel.dispatch_retries.inc()
+                        time.sleep(self.retry_backoff_s * (2 ** attempt))
         except BaseException:
             with engine._depth_lock:
                 engine._pipeline_depth -= 1
             raise
+        if error is not None:
+            # retries exhausted: retire every joined row CLEANLY — no
+            # position advanced and no slab row was consumed by a completed
+            # program, the rows' requests fail with a typed error, and the
+            # scheduler keeps serving future requests
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+            tel = engine._tel
+            tel.rows_quarantined.inc(len(joined))
+            for s in joined:
+                err = faults.RowQuarantined(
+                    "batched chunk dispatch failed after "
+                    f"{self.retries + 1} attempts; this row's request was "
+                    "retired"
+                )
+                err.__cause__ = error
+                s._fetch_error = err
+            self._cond.notify_all()
+            return
         for s in joined:
             # the next chunk seeds from this chunk's last token and advanced
             # key — both stay device-resident (no fetch on the critical path)
@@ -561,60 +707,137 @@ class BatchScheduler:
             tokens, [(s, s._epoch) for s in joined], bucket, len(joined), sw,
         )
 
-    def _fetch(self, pend) -> None:
+    def _fetch(self, pend, gen: int) -> None:
         """Blocking fetch of a dispatched chunk (no scheduler lock held);
-        delivers each joined row's column into its stream queue. The epoch
-        check keeps a late fetch from feeding a row's NEXT occupant."""
+        delivers each joined row's column into its stream queue. Transient
+        fetch failures retry with backoff; a chunk whose tokens come back
+        corrupted for ONE row (the NaN-logits class of failure — detected
+        as out-of-vocab ids, injectable via the ``batch.row`` site)
+        quarantines only that row, and the surviving rows' streams are
+        delivered untouched — bit-identical to a fault-free run. The epoch
+        check keeps a late fetch from feeding a row's NEXT occupant; the
+        generation check keeps a watchdog-killed fetch from delivering at
+        all."""
         engine = self.engine
         tokens_dev, snapshot, bucket, n_active, sw = pend
         toks = None
-        error: BaseException | None = None
+        error: Exception | None = None
         try:
-            try:
-                tokens_dev.copy_to_host_async()
-            except Exception:
-                pass  # optional acceleration; np.asarray below is the contract
-            with engine._tel.span("batch_decode_fetch", bucket=bucket):
-                toks = np.asarray(tokens_dev)  # [chunk, bucket]
-        except BaseException as e:
-            error = e
-            raise
-        finally:
-            with engine._depth_lock:
-                engine._pipeline_depth -= 1
-            per_token_ms = sw.elapsed_ms() / self.chunk
-            # the I/T split may trigger a transfer re-measurement (a device
-            # round trip under TP) — run it BEFORE taking the scheduler
-            # lock so a probe never blocks every lane's join/dispatch
-            entry = engine._split_stats(per_token_ms)
-            tel = engine._tel
+            for attempt in range(self.retries + 1):
+                try:
+                    self._faults.fire("batch.fetch")
+                    try:
+                        tokens_dev.copy_to_host_async()
+                    except Exception:
+                        pass  # optional acceleration; np.asarray is the contract
+                    with engine._tel.span("batch_decode_fetch", bucket=bucket):
+                        toks = np.asarray(tokens_dev)  # [chunk, bucket]
+                    error = None
+                    break
+                except Exception as e:
+                    # Exception only: a KeyboardInterrupt/SystemExit mid-fetch
+                    # must abort the process, not be retried into quarantines
+                    error = e
+                    if attempt < self.retries:
+                        engine._tel.fetch_retries.inc()
+                        time.sleep(self.retry_backoff_s * (2 ** attempt))
+        except BaseException:
+            # a KeyboardInterrupt/SystemExit mid-fetch: release the in-flight
+            # accounting (unless the watchdog already took it) and propagate
             with self._cond:
-                self._fetching = False
-                for s, epoch in snapshot:
-                    if s._joined and s._epoch == epoch:
-                        if toks is not None:
-                            s._queue.extend(int(t) for t in toks[:, s.row])
-                            s.stats.extend([entry] * self.chunk)
-                            if tel.enabled:
-                                tel.kv_occupancy.set(
-                                    min(s.pos / engine.cfg.seq_len, 1.0)
-                                )
-                        else:
-                            # the chunk's tokens are lost but every row's
-                            # position already advanced at dispatch:
-                            # poison the co-batched streams so their
-                            # requests FAIL instead of emitting a silent
-                            # token hole
-                            s._fetch_error = error
+                owned = self._fetching and self._fetch_gen == gen
+                if owned:
+                    self._fetching = False
+                    self._fetch_started = None
+                    with engine._depth_lock:
+                        engine._pipeline_depth -= 1
                 self._cond.notify_all()
+            raise
+        # phase 1 of the completion claim (the watchdog declares stalls
+        # under the same lock, so exactly one side — this fetch or the
+        # watchdog — releases the depth hold and settles the rows):
+        # clearing _fetch_started makes this fetch un-stallable, but
+        # _fetching stays TRUE until the delivery block below — otherwise
+        # another thread could take the pending speculative chunk N+1 and
+        # deliver its tokens ahead of chunk N's during the stats window
+        with self._cond:
+            owned = self._fetching and self._fetch_gen == gen
+            if owned:
+                self._fetch_started = None
+                with engine._depth_lock:
+                    engine._pipeline_depth -= 1
+        if not owned:
+            # the watchdog retired this generation mid-fetch: the joined
+            # rows already hold StallTimeout errors, the depth hold was
+            # released on our behalf, and a newer fetch may be in flight —
+            # deliver nothing
+            with self._cond:
+                self._cond.notify_all()
+            return
+        per_token_ms = sw.elapsed_ms() / self.chunk
+        # the I/T split may trigger a transfer re-measurement (a device
+        # round trip under TP) — run it BEFORE taking the scheduler
+        # lock so a probe never blocks every lane's join/dispatch
+        entry = engine._split_stats(per_token_ms)
         tel = engine._tel
-        if tel.enabled:
-            tel.tokens_generated.inc(self.chunk * n_active)
+        bad_rows: set[int] = set()
+        if toks is not None:
+            rule = self._faults.fires(
+                "batch.row", rows=[s.row for s, _ in snapshot]
+            )
+            if (
+                rule is not None
+                and rule.row is not None
+                and 0 <= rule.row < toks.shape[1]
+            ):
+                toks = toks.copy()
+                toks[:, rule.row] = -1  # rejected by the validation below
+            vocab = engine.cfg.vocab_size
+            for s, _ in snapshot:
+                col = toks[:, s.row]
+                if not ((col >= 0) & (col < vocab)).all():
+                    bad_rows.add(s.row)
+        delivered = 0
+        with self._cond:
+            # phase 2: deliver and release fetch ownership in ONE block, so
+            # the pending chunk N+1 can only be taken (and its tokens
+            # queued) strictly after chunk N's tokens are in the queues
+            self._fetching = False
+            for s, epoch in snapshot:
+                if not (s._joined and s._epoch == epoch):
+                    continue
+                if toks is None or s.row in bad_rows:
+                    # the row's tokens are lost/corrupt and its position
+                    # already advanced at dispatch: retire THIS row with
+                    # a typed error instead of emitting a silent token
+                    # hole — and instead of the seed's poison-everyone
+                    err = faults.RowQuarantined(
+                        "batch row retired: chunk "
+                        + (
+                            f"fetch failed after {self.retries + 1} attempts"
+                            if toks is None
+                            else "produced corrupt tokens (NaN-logits "
+                            "class failure)"
+                        )
+                    )
+                    err.__cause__ = error
+                    s._fetch_error = err
+                    tel.rows_quarantined.inc()
+                    continue
+                s._queue.extend(int(t) for t in toks[:, s.row])
+                s.stats.extend([entry] * self.chunk)
+                delivered += 1
+                if tel.enabled:
+                    tel.kv_occupancy.set(
+                        min(s.pos / engine.cfg.seq_len, 1.0)
+                    )
+            self._cond.notify_all()
+        if tel.enabled and delivered:
+            tel.tokens_generated.inc(self.chunk * delivered)
             tel.decode_latency.observe(per_token_ms / 1000.0)
         # a chunk kicked WHILE this fetch was in flight may already be
         # orphaned (its kicker stopped at the fused first token and its
         # _leave-time drain skipped because _fetching was still true):
         # re-check the idle-drain condition now that the fetch is done —
-        # the one-pending-slot invariant bounds the recursion. A fetch that
-        # RAISED skips this, but the failing request's own _leave drains.
+        # the one-pending-slot invariant bounds the recursion.
         self._drain_if_idle()

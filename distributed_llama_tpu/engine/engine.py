@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine import weights as weights_lib
 from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.models import llama
@@ -83,6 +84,11 @@ class EngineStream:
         # True while this stream's un-fetched prefill_device dispatch holds
         # the engine's pipeline depth up (released at the first-token fetch)
         self._depth_held = False
+        # per-request deadline (time.monotonic seconds): enforced by the
+        # serving layer per token; carried here so both stream kinds share
+        # the surface (the batch scheduler additionally enforces it
+        # between chunks — see engine/batch.py)
+        self.deadline: float | None = None
         engine._streams.append(self)
         engine._tel.active_streams.set(len(engine._streams))
 
@@ -129,6 +135,7 @@ class EngineStream:
         self.stats.clear()
         self._release_depth()  # an abandoned un-fetched prefill must not pin the depth
         self._pending_prefill_entry = None
+        self.deadline = None
 
     def rollback(self, pos: int) -> None:
         """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots
@@ -144,6 +151,7 @@ class EngineStream:
         callers append their fetch to the same stats entry implicitly by
         measuring around their np.asarray)."""
         engine = self.engine
+        engine._faults.fire("engine.forward")
         n = tokens.shape[0]
         if n == 0:
             raise ValueError("empty token batch: at least one token required")
@@ -346,6 +354,7 @@ class EngineStream:
         from distributed_llama_tpu.models import sampling
 
         engine = self.engine
+        engine._faults.fire("engine.decode_dispatch")
         with engine._tel.span("decode_chunk_dispatch", pos=self.pos, steps=n_steps):
             if engine._tp_engine is not None:
                 tokens, self.cache, key = engine._tp_engine.decode_chunk(
@@ -499,6 +508,7 @@ class EngineStream:
                 nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
             else:
                 nxt, k = None, 0
+            engine._faults.fire("engine.fetch")
             with engine._tel.span("decode_chunk_fetch", tokens=pending_n):
                 try:
                     # start the device->host copy without blocking: behind a
@@ -548,23 +558,29 @@ class EngineStream:
         consumed = 0
         fused_first = first_prev is not None
         prev = first_prev if fused_first else int(first_token)
-        for t in self.generate_chunks(
-            first_token, temperature, topp, seed=seed, chunk=chunk, limit=limit,
-            key=key, emit_first=fused_first,
-        ):
-            consumed += 1
-            keep_going = on_token(prev, t)
-            prev = t
-            # with a fused first token, yield i corresponds to stream
-            # position start_pos + i - 1 (the first yield was sampled during
-            # prefill and occupies no new position until fed)
-            fed = consumed - 1 if fused_first else consumed
-            if keep_going is False:
-                break
-            if limit is not None and start_pos + fed >= limit:
-                break
-        fed = max(consumed - 1, 0) if fused_first else consumed
-        self.rollback(start_pos + fed)
+        try:
+            for t in self.generate_chunks(
+                first_token, temperature, topp, seed=seed, chunk=chunk,
+                limit=limit, key=key, emit_first=fused_first,
+            ):
+                consumed += 1
+                keep_going = on_token(prev, t)
+                prev = t
+                # with a fused first token, yield i corresponds to stream
+                # position start_pos + i - 1 (the first yield was sampled
+                # during prefill and occupies no new position until fed)
+                fed = consumed - 1 if fused_first else consumed
+                if keep_going is False:
+                    break
+                if limit is not None and start_pos + fed >= limit:
+                    break
+        finally:
+            # the rollback must run even when on_token RAISES (an SSE client
+            # disconnect mid-stream, a deadline expiry): without it the slot's
+            # next request sees a position inflated by the overshot
+            # speculative chunk and needlessly resets its prefix cache
+            fed = max(consumed - 1, 0) if fused_first else consumed
+            self.rollback(min(start_pos + fed, self.pos))
         # the stream is drained here (generator closed, last chunk fetched):
         # the one quiescent point of the fused serving flow — refresh the
         # transfer estimate on cadence for FUTURE entries (every stats entry
@@ -630,6 +646,10 @@ class InferenceEngine:
         self._tel = telemetry.EngineInstruments()
         if ep > 1 and sp > 1:
             raise ValueError("--ep and --sp do not compose (pick one FFN/context strategy)")
+        # fault-injection plan bound ONCE per engine (the same bind-once
+        # contract as telemetry: the no-op NULL_PLAN when no chaos plan is
+        # installed — hot paths pay one attribute call per dispatch)
+        self._faults = faults.active_plan()
         # the parallel backend is constructed BEFORE the weights load so the
         # q40 sharded load can place each shard's pack straight onto its
         # device via make_array_from_callback — each process reads only its
@@ -834,7 +854,15 @@ class InferenceEngine:
                 self._transfer_ms is None
                 or n - self._transfer_measured_at >= self.TRANSFER_REFRESH_TOKENS
             ):
-                self._transfer_ms = self._tp_engine.measure_transfer_ms()
+                try:
+                    self._transfer_ms = self._tp_engine.measure_transfer_ms()
+                except Exception:
+                    # a failed probe (flaky interconnect, injected tp.transfer
+                    # fault) must not kill the request that happened to
+                    # trigger it: keep the previous estimate (0 before any
+                    # measurement succeeded) and retry next cadence
+                    if self._transfer_ms is None:
+                        self._transfer_ms = 0.0
                 self._transfer_measured_at = n
             return self._transfer_ms
 

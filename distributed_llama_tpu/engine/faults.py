@@ -1,0 +1,288 @@
+"""Deterministic fault injection for chaos testing (ISSUE 3).
+
+The reference distributed-llama assumes a fault-free world: a worker socket
+error or a hung dispatch kills the whole root process (reference:
+src/apps/dllama/dllama.cpp:418-423 — no error path at all). This module is
+the opposite posture made testable: a process-wide :class:`FaultPlan` with
+NAMED injection sites threaded through the engine, the batch scheduler, the
+parallel backends and the API server, so chaos tests can provoke the exact
+failure they want — deterministically, from a seed — and assert the system
+degrades instead of collapsing.
+
+Injection sites (the strings passed to :meth:`FaultPlan.fire`):
+
+==================  =========================================================
+``batch.dispatch``  raise inside the batched chunk dispatch
+                    (engine/batch.py ``_dispatch_locked``; retried with
+                    backoff before the rows are retired)
+``batch.fetch``     raise/delay/hang inside the batched chunk fetch
+                    (``_fetch``; a raise models a transfer error and is
+                    retried, a hang trips the stall watchdog)
+``batch.row``       corrupt ONE row of a fetched chunk (``kind=nan`` with a
+                    ``row=``) — stands in for NaN logits from a single
+                    sequence; the scheduler quarantines only that row
+``engine.forward``  raise at any single-stream forward dispatch
+``engine.decode_dispatch``  raise at a single-stream decode-chunk dispatch
+``engine.fetch``    raise/delay at the single-stream chunk fetch
+``tp.transfer``     raise/delay inside the transfer probe (the engine keeps
+                    its last estimate instead of dying)
+``server.send``     raise ``BrokenPipeError`` from the SSE chunk writer
+                    (``kind=disconnect``) — models a client disconnect
+==================  =========================================================
+
+Zero overhead when disabled — the same bind-once trick as telemetry:
+components bind ``self._faults = faults.active_plan()`` at construction and
+get the shared :data:`NULL_PLAN` singleton (no-op ``fire``/``fires``) when
+no plan is installed. Hot paths pay one attribute-bound no-op call per
+*dispatch*, never per token, and never touch this module's globals.
+Install a plan BEFORE constructing the engine/scheduler/server.
+
+Configuration
+-------------
+* env: ``DLLAMA_FAULTS="batch.fetch:kind=raise,after=2,count=1"`` (read once
+  at import; ``DLLAMA_FAULTS_SEED`` seeds probabilistic rules), or
+* flag: ``dllama-tpu-api --faults "<spec>"``, or
+* code: ``faults.install(faults.parse(spec, seed=0))``.
+
+A spec is ``;``-separated rules, each ``site:key=val,key=val`` (or a JSON
+array of rule objects). Fields: ``kind`` (``raise`` | ``nan`` | ``delay`` |
+``hang`` | ``disconnect``), ``after`` (skip the first N hits of the site),
+``count`` (fire on this many subsequent hits; -1 = forever), ``p``
+(per-hit probability, drawn from the seeded RNG), ``row`` (restrict to one
+batch row), ``delay_ms`` (for ``delay``/``hang``). Full format and
+semantics: docs/ROBUSTNESS.md.
+
+Determinism: site-hit counters are lock-protected and count every hook
+invocation, so ``after``/``count`` rules fire on exactly the same hits on
+every run. ``p < 1`` rules draw from one seeded RNG in hit order — fully
+reproducible for single-pump sites (the batch scheduler dispatch/fetch),
+reproducible up to thread interleaving elsewhere.
+
+Every actual injection increments ``dllama_faults_injected_total{site}``
+(when telemetry is enabled) and the plan's plain ``injected_total``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+
+
+class InjectedFault(RuntimeError):
+    """Raised at an injection site by a ``kind=raise`` rule."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request ran past its deadline: the row left the batch and the
+    stream ends (the API server maps this to 504 / an SSE error event)."""
+
+
+class RowQuarantined(RuntimeError):
+    """This request's batch row was retired after a failed or corrupted
+    chunk (bounded retries exhausted); co-batched rows keep streaming."""
+
+
+class StallTimeout(RuntimeError):
+    """The watchdog declared an in-flight batched chunk stalled and failed
+    the batch cleanly (the hung fetch's late result is discarded)."""
+
+
+KINDS = ("raise", "nan", "delay", "hang", "disconnect")
+
+# a "hang" sleeps this long unless the rule sets delay_ms — far beyond any
+# stall timeout, short enough that a daemon-threaded test process still exits
+HANG_DEFAULT_MS = 60_000.0
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One injection rule. See the module docstring for field semantics."""
+
+    site: str
+    kind: str = "raise"
+    after: int = 0
+    count: int = 1
+    p: float = 1.0
+    row: int | None = None
+    delay_ms: float = 0.0
+    message: str = ""
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (one of {KINDS})")
+        if not self.site:
+            raise ValueError("fault rule needs a site")
+
+
+class FaultPlan:
+    """A seeded set of :class:`FaultRule`\\ s plus the per-site hit
+    counters that make ``after``/``count``/``p`` deterministic."""
+
+    enabled = True
+
+    def __init__(self, rules, seed: int = 0):
+        self.rules: list[FaultRule] = list(rules)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._hits: dict[str, int] = {}
+        self._fired: dict[int, int] = {}
+        self._rng = random.Random(self.seed)
+        self.injected_total = 0  # plain count: readable with telemetry off
+
+    def reset(self) -> None:
+        """Rewind the hit/fired counters and the RNG (same plan, fresh run)."""
+        with self._lock:
+            self._hits.clear()
+            self._fired.clear()
+            self._rng = random.Random(self.seed)
+
+    def _match(
+        self, site: str, row: int | None = None, rows=None
+    ) -> FaultRule | None:
+        with self._lock:
+            hit = self._hits.get(site, 0)
+            self._hits[site] = hit + 1
+            for i, r in enumerate(self.rules):
+                if r.site != site:
+                    continue
+                if hit < r.after:
+                    continue
+                fired = self._fired.get(i, 0)
+                if r.count >= 0 and fired >= r.count:
+                    continue
+                if row is not None and r.row is not None and r.row != row:
+                    continue
+                if rows is not None and r.row is not None and r.row not in rows:
+                    # the targeted row is not riding this hit (e.g. not in
+                    # the current batch bucket): hold the rule WITHOUT
+                    # consuming its count — it fires when the victim shows up
+                    continue
+                if r.p < 1.0 and self._rng.random() >= r.p:
+                    continue
+                self._fired[i] = fired + 1
+                self.injected_total += 1
+                # resolved per injection, NOT bound at construction: an
+                # env-installed plan exists before a --telemetry flag
+                # enables the registry, and injections are rare enough
+                # that the lookup costs nothing (telemetry off → null)
+                from distributed_llama_tpu import telemetry
+
+                telemetry.counter(
+                    "dllama_faults_injected_total",
+                    "Faults actually injected by the active chaos plan, "
+                    "by site",
+                    labelnames=("site",),
+                ).labels(site=site).inc()
+                return r
+        return None
+
+    def fire(self, site: str, row: int | None = None) -> FaultRule | None:
+        """The hook call sites thread through the hot paths: raises for
+        ``raise``/``disconnect`` rules, sleeps for ``delay``/``hang``,
+        returns the matched rule (or None) otherwise."""
+        rule = self._match(site, row=row)
+        if rule is None:
+            return None
+        if rule.kind == "raise":
+            raise InjectedFault(rule.message or f"injected fault at {site}")
+        if rule.kind == "disconnect":
+            raise BrokenPipeError(
+                rule.message or f"injected client disconnect at {site}"
+            )
+        if rule.kind in ("delay", "hang"):
+            ms = rule.delay_ms or (HANG_DEFAULT_MS if rule.kind == "hang" else 0.0)
+            time.sleep(ms / 1000.0)
+        return rule
+
+    def fires(self, site: str, row: int | None = None, rows=None) -> FaultRule | None:
+        """Non-raising variant for data-corruption sites (``kind=nan``):
+        the call site applies the corruption itself from the returned rule.
+        ``rows`` names the rows riding this hit — a row-targeted rule holds
+        (count unconsumed) until its victim is present."""
+        return self._match(site, row=row, rows=rows)
+
+
+class _NullPlan:
+    """Disabled-mode bind target: stateless no-op singleton (the faults
+    analogue of telemetry's null instruments)."""
+
+    __slots__ = ()
+    enabled = False
+    injected_total = 0
+
+    def fire(self, site: str, row: int | None = None) -> None:
+        return None
+
+    def fires(self, site: str, row: int | None = None, rows=None) -> None:
+        return None
+
+
+NULL_PLAN = _NullPlan()
+
+_active: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Make ``plan`` the process-wide active plan. Components bind at
+    construction — install BEFORE building the engine/scheduler/server."""
+    global _active
+    _active = plan
+    return plan
+
+
+def clear() -> None:
+    global _active
+    _active = None
+
+
+def active_plan() -> FaultPlan | _NullPlan:
+    """The bind-once entry point: the active plan, or the no-op singleton."""
+    return _active if _active is not None else NULL_PLAN
+
+
+_INT_FIELDS = ("after", "count", "row")
+_FLOAT_FIELDS = ("p", "delay_ms")
+
+
+def parse(spec: str, seed: int = 0) -> FaultPlan:
+    """Parse a fault-plan spec: ``;``-separated ``site:key=val,key=val``
+    rules, or a JSON array/object of rule fields (docs/ROBUSTNESS.md)."""
+    spec = (spec or "").strip()
+    rules: list[FaultRule] = []
+    if spec.startswith("[") or spec.startswith("{"):
+        data = json.loads(spec)
+        if isinstance(data, dict):
+            data = [data]
+        rules = [FaultRule(**d) for d in data]
+    else:
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            site, _, kvs = part.partition(":")
+            kw: dict = {"site": site.strip()}
+            for kv in filter(None, (x.strip() for x in kvs.split(","))):
+                k, _, v = kv.partition("=")
+                k, v = k.strip(), v.strip()
+                if k in _INT_FIELDS:
+                    kw[k] = int(v)
+                elif k in _FLOAT_FIELDS:
+                    kw[k] = float(v)
+                elif k in ("kind", "message"):
+                    kw[k] = v
+                else:
+                    raise ValueError(f"unknown fault-rule field {k!r}")
+            rules.append(FaultRule(**kw))
+    if not rules:
+        raise ValueError(f"empty fault plan: {spec!r}")
+    return FaultPlan(rules, seed=seed)
+
+
+_ENV_VAR = "DLLAMA_FAULTS"
+_env_spec = os.environ.get(_ENV_VAR, "").strip()
+if _env_spec:
+    install(parse(_env_spec, seed=int(os.environ.get("DLLAMA_FAULTS_SEED", "0") or 0)))

@@ -1,0 +1,70 @@
+"""Synthetic `.m` models: random seeded weights in the real file format.
+
+The one shared implementation behind the test suite's tiny golden models
+(tests/model_utils.py re-exports these) and the chaos bench
+(``bench.py --chaos``) — the analogue of the reference's synthetic-spec
+golden tests (src/llama2-tasks-test.cpp:531-565), with the xorshift weight
+fill replaced by seeded numpy. Keeping it next to ModelFileWriter means the
+init rules (rms weights near 1, everything else ~N(0, 1/sqrt(d_in))) and
+the tensor-name layout cannot drift between consumers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_llama_tpu.formats.model_file import (
+    ArchType,
+    HiddenAct,
+    ModelFileWriter,
+    ModelSpec,
+    RopeType,
+    tensor_layout,
+)
+from distributed_llama_tpu.quants import FloatType
+
+
+def tiny_spec(**overrides) -> ModelSpec:
+    """A CPU-friendly llama spec; override any field (seq_len, dims, ...)."""
+    defaults = dict(
+        arch_type=ArchType.LLAMA,
+        dim=32,
+        hidden_dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        vocab_size=64,
+        seq_len=24,
+        hidden_act=HiddenAct.SILU,
+        rope_theta=10000.0,
+        rope_type=RopeType.UNKNOWN,
+        weights_float_type=FloatType.F32,
+    )
+    defaults.update(overrides)
+    return ModelSpec(**defaults)
+
+
+def random_tensors(spec: ModelSpec, seed: int = 0) -> dict[str, np.ndarray]:
+    """Random weights keyed by the `.m` layout names, shaped [d_out, d_in]."""
+    rng = np.random.RandomState(seed)
+    out: dict[str, np.ndarray] = {}
+    for e in tensor_layout(spec):
+        if e.name.startswith("rms") or ".rms" in e.name:
+            t = 1.0 + 0.1 * rng.randn(*e.shape)
+        else:
+            t = rng.randn(*e.shape) / np.sqrt(e.shape[-1])
+        out[e.name] = t.astype(np.float32)
+    return out
+
+
+def write_model_file(path: str, spec: ModelSpec, tensors: dict[str, np.ndarray]) -> None:
+    with open(path, "wb") as f:
+        w = ModelFileWriter(f, spec)
+        for e in w.remaining():
+            w.write_tensor(tensors[e.name], e.name)
+
+
+def write_synthetic_model(path: str, spec: ModelSpec, seed: int = 0) -> str:
+    """One-call helper: random weights for ``spec`` written to ``path``."""
+    write_model_file(path, spec, random_tensors(spec, seed=seed))
+    return path

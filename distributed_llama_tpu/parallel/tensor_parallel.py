@@ -218,6 +218,17 @@ class TransferProbeMixin:
             self._collective_tel_bundle = tel
         return tel
 
+    def _faults_plan(self):
+        """Bind-once fault-injection plan (engine/faults.py): the no-op
+        NULL_PLAN unless a chaos plan was installed before construction."""
+        plan = getattr(self, "_faults_plan_bound", None)
+        if plan is None:
+            from distributed_llama_tpu.engine import faults as _faults
+
+            plan = _faults.active_plan()
+            self._faults_plan_bound = plan
+        return plan
+
     def transfer_bytes_per_token(self) -> int:
         """Estimated LOGICAL payload bytes the probed collective sequence
         moves per token (f32 activations; backends override with their own
@@ -233,6 +244,10 @@ class TransferProbeMixin:
         TASK_TYPE_TRANSFER wall-time accounting (src/utils.cpp:216-218)."""
         from distributed_llama_tpu.telemetry import Stopwatch
 
+        # transfer-error injection site (chaos tests): a raise here models a
+        # flaky interconnect — the engine keeps its previous estimate instead
+        # of failing the request that triggered the probe (engine.py)
+        self._faults_plan().fire("tp.transfer")
         tel = self._collective_tel()
         jitted, args = self._transfer_probe_cached(n_tokens)
         with tel.span("transfer_probe", tokens=n_tokens):

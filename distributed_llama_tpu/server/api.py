@@ -47,12 +47,16 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
+import signal
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.engine import faults
+from distributed_llama_tpu.engine.faults import DeadlineExceeded
 from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.tokenizer import (
     ChatItem,
@@ -78,6 +82,19 @@ def new_request_id() -> str:
 
 class BadRequest(ValueError):
     """Client error in a request body — mapped to HTTP 400 by the handler."""
+
+
+class AdmissionRejected(RuntimeError):
+    """The bounded admission queue is full — mapped to HTTP 429 with a
+    ``Retry-After`` header (the alternative is the seed's unbounded queue:
+    every queued client holds a socket + handler thread while its own
+    timeout burns, then retries into an even deeper queue)."""
+
+
+class ServerDraining(RuntimeError):
+    """The server received SIGTERM and stopped admitting — mapped to HTTP
+    503 with ``Retry-After`` so load balancers move on while in-flight
+    completions finish."""
 
 
 @dataclasses.dataclass
@@ -163,7 +180,8 @@ class ApiState:
 
             try:
                 self.batch = BatchScheduler(
-                    engine, n_rows=n, chunk=getattr(args, "decode_chunk", 32)
+                    engine, n_rows=n, chunk=getattr(args, "decode_chunk", 32),
+                    stall_timeout_s=getattr(args, "stall_timeout_s", None),
                 )
             except ValueError as e:  # backend without a batched path (sp/ep)
                 print(f"⚠️ batch decode disabled: {e}")
@@ -185,17 +203,73 @@ class ApiState:
         self.cache = self.slots[0].cache  # single-stream tests poke this
         self._mutex = threading.Lock()
         self._free = threading.Semaphore(n)
+        # fault tolerance (ISSUE 3): bounded admission queue, per-request
+        # deadlines, request-body cap, and the SIGTERM drain flag
+        aq = getattr(args, "admission_queue", None)
+        self.queue_limit = max(0, int(aq)) if aq is not None else 2 * n
+        mb = getattr(args, "max_body_bytes", None)  # 0 is a valid cap — no falsy-or
+        self.max_body_bytes = int(mb) if mb is not None else (1 << 20)
+        self.default_deadline_ms = getattr(args, "deadline_ms", None)
+        self.retry_after_s = 1
+        self.draining = False
+        self._admission_lock = threading.Lock()
+        self._waiting = 0
         # server instrument bundle (requests / duration / in-flight / queue
         # wait): real registry metrics when telemetry is enabled at startup,
         # shared no-op singletons otherwise
         self.tel = telemetry.ServerInstruments()
+        # bind-once fault-injection plan (engine/faults.py): the SSE writer
+        # fires the server.send site through it (kind=disconnect models a
+        # client vanishing mid-stream)
+        self.faults = faults.active_plan()
 
-    def _acquire_slot(self, messages: list[dict]) -> StreamSlot:
-        """Block until a lane is free, then take the free lane whose chat
-        prefix cache reuses the most of this request (prefix affinity keeps
-        multi-turn KV reuse working under concurrency)."""
+    def begin_drain(self) -> None:
+        """Stop admitting new completions (SIGTERM): queued/new requests get
+        503 + Retry-After, ``/readyz`` flips 503, in-flight requests finish.
+        Idempotent."""
+        self.draining = True
+        self.tel.draining.set(1)
+
+    def _acquire_slot(
+        self, messages: list[dict], deadline: float | None = None
+    ) -> StreamSlot:
+        """Take a free lane, queueing BOUNDEDLY when all are busy: at most
+        ``queue_limit`` requests wait (excess get AdmissionRejected → 429),
+        and a queued request whose deadline expires leaves with
+        DeadlineExceeded → 504 instead of burning its remaining budget in
+        line. The chosen lane is the free one whose chat prefix cache
+        reuses the most of this request (prefix affinity keeps multi-turn
+        KV reuse working under concurrency)."""
         sw = Stopwatch()
-        self._free.acquire()
+        if not self._free.acquire(blocking=False):
+            with self._admission_lock:
+                if self.draining:
+                    raise ServerDraining("server is draining; not admitting")
+                if self._waiting >= self.queue_limit:
+                    self.tel.admission_rejected.inc()
+                    raise AdmissionRejected(
+                        f"admission queue full ({self._waiting} waiting, "
+                        f"limit {self.queue_limit}); retry after "
+                        f"{self.retry_after_s}s"
+                    )
+                self._waiting += 1
+            try:
+                timeout = (
+                    None if deadline is None
+                    else max(deadline - time.monotonic(), 0.0)
+                )
+                if not self._free.acquire(timeout=timeout):
+                    raise DeadlineExceeded(
+                        "deadline expired while queued for a free slot"
+                    )
+            finally:
+                with self._admission_lock:
+                    self._waiting -= 1
+        if self.draining:
+            # a SIGTERM that landed while this request queued: give the slot
+            # back and bounce — the drain waiter counts acquirable slots
+            self._free.release()
+            raise ServerDraining("server is draining; not admitting")
         self.tel.queue_wait.observe(sw.elapsed_s())
         with self._mutex:
             free = [s for s in self.slots if not s.busy]
@@ -230,17 +304,33 @@ class ApiState:
             params = self._parse(body)
         if request_id is None:
             request_id = new_request_id()
-        slot = self._acquire_slot(params["messages"])
+        # deadline: request deadline_ms, else the server default; converted
+        # to a monotonic instant ONCE so queue wait, prefill and decode all
+        # burn the same budget. Enforced here per token (feed), by the batch
+        # scheduler between chunks, and by the bounded admission queue.
+        deadline_ms = params.get("deadline_ms") or self.default_deadline_ms
+        deadline = (
+            time.monotonic() + float(deadline_ms) / 1000.0
+            if deadline_ms else None
+        )
+        if self.draining:
+            raise ServerDraining("server is draining; not admitting")
+        slot = self._acquire_slot(params["messages"], deadline)
         try:
-            return self._complete_on(slot, params, send_chunk, request_id)
+            slot.stream.deadline = deadline
+            return self._complete_on(slot, params, send_chunk, request_id, deadline)
         finally:
+            slot.stream.deadline = None
             self._release_slot(slot)
 
     def _complete_on(
-        self, slot: StreamSlot, params: dict, send_chunk, request_id: str
+        self, slot: StreamSlot, params: dict, send_chunk, request_id: str,
+        deadline: float | None = None,
     ) -> dict | None:
         engine, tokenizer = slot.stream, self.tokenizer
         stream = params["stream"]
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded("deadline expired before prefill")
 
         start_pos, delta_messages = slot.cache.resolve_delta_prompt(params["messages"])
         engine.rollback(min(start_pos, engine.pos))
@@ -307,6 +397,13 @@ class ApiState:
 
         def feed(prev: int, token: int) -> EosDetectorResult:
             nonlocal emitted
+            if deadline is not None and time.monotonic() >= deadline:
+                # per-token deadline enforcement (both decode paths; the
+                # batch scheduler additionally retires the row between
+                # chunks): the stream ends 504 / an SSE error event
+                raise DeadlineExceeded(
+                    f"deadline expired after {emitted} tokens"
+                )
             emitted += 1
             piece = tokenizer.decode_piece(prev, token)
             res = detector.append(token, piece if is_safe_piece(piece) else b"")
@@ -461,8 +558,17 @@ class ApiState:
             seed = body.get("seed")
             if seed is not None:
                 seed = int(seed)
+            deadline_ms = body.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
         except (TypeError, ValueError) as e:
             raise BadRequest(f"invalid numeric field: {e}") from None
+        if deadline_ms is not None and not (
+            math.isfinite(deadline_ms) and deadline_ms > 0
+        ):
+            # NaN must not pass: it poisons every monotonic comparison AND
+            # Semaphore.acquire(timeout=nan) blocks forever
+            raise BadRequest("'deadline_ms' must be a positive finite number of ms")
         return {
             "messages": [
                 {"role": m["role"], "content": m["content"]} for m in messages
@@ -472,6 +578,7 @@ class ApiState:
             "seed": seed,
             "max_tokens": max_tokens,
             "stop": [s for s in stop if s],
+            "deadline_ms": deadline_ms,
         }
 
 
@@ -498,6 +605,22 @@ def make_handler(state: ApiState):
                 self.end_headers()
                 self.wfile.write(payload)
                 state.tel.requests.labels(route="/v1/models", status="200").inc()
+            elif self.path == "/healthz":
+                # liveness: the HTTP loop and handler threads are alive. A
+                # quarantined batch row or a watchdog-failed chunk does NOT
+                # flip this — graceful degradation is healthy (ISSUE 3)
+                self._send_json(200, {"status": "ok"})
+                state.tel.requests.labels(route="/healthz", status="200").inc()
+            elif self.path == "/readyz":
+                # readiness: admitting new work. Flips 503 on SIGTERM drain
+                # so load balancers stop routing here while in-flight
+                # completions finish
+                if state.draining:
+                    self._send_json(503, {"status": "draining"})
+                    state.tel.requests.labels(route="/readyz", status="503").inc()
+                else:
+                    self._send_json(200, {"status": "ready"})
+                    state.tel.requests.labels(route="/readyz", status="200").inc()
             elif self.path == "/metrics":
                 # Prometheus text exposition of the process-global registry
                 # (engine + server + collective instruments). Valid, possibly
@@ -517,7 +640,8 @@ def make_handler(state: ApiState):
                 state.tel.requests.labels(route="other", status="404").inc()
 
         def _send_json(
-            self, status: int, payload: dict, request_id: str | None = None
+            self, status: int, payload: dict, request_id: str | None = None,
+            extra_headers: dict | None = None,
         ) -> None:
             data = json.dumps(payload).encode()
             self.send_response(status)
@@ -525,6 +649,8 @@ def make_handler(state: ApiState):
             self.send_header("Content-Length", str(len(data)))
             if request_id is not None:
                 self.send_header("X-Request-Id", request_id)
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -564,8 +690,35 @@ def make_handler(state: ApiState):
             if self.path != "/v1/chat/completions":
                 self.send_error(404)
                 return "404"
-            length = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(length) or b"{}"
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                self._send_json(
+                    400,
+                    self._error_body(
+                        "invalid Content-Length", "invalid_request_error", rid
+                    ),
+                    request_id=rid,
+                )
+                self.close_connection = True
+                return "400"
+            if length > state.max_body_bytes:
+                # bounded request bodies (ISSUE 3 satellite): the seed's
+                # rfile.read trusted ANY Content-Length — one request could
+                # balloon host memory. Reject WITHOUT reading; the unread
+                # body makes the connection unreusable, so close it.
+                self._send_json(
+                    413,
+                    self._error_body(
+                        f"request body {length} bytes exceeds the "
+                        f"{state.max_body_bytes}-byte limit",
+                        "request_too_large", rid,
+                    ),
+                    request_id=rid,
+                )
+                self.close_connection = True
+                return "413"
+            raw = self.rfile.read(max(length, 0)) or b"{}"
             try:
                 body = json.loads(raw)
             except json.JSONDecodeError as e:
@@ -576,7 +729,7 @@ def make_handler(state: ApiState):
                 )
                 return "400"
             try:
-                # validate BEFORE any SSE headers go out: a 400 must be a
+                # validate BEFORE any SSE bytes go out: a 400 must be a
                 # clean HTTP error, not a broken event stream
                 params = state._parse(body)
             except BadRequest as e:
@@ -585,19 +738,41 @@ def make_handler(state: ApiState):
                     request_id=rid,
                 )
                 return "400"
-            try:
-                if body.get("stream"):
+            # SSE headers go out lazily with the FIRST event: a request
+            # rejected by admission control (429), the drain gate (503) or
+            # its own deadline (504) before any token still gets a clean
+            # HTTP status instead of a 200 + broken event stream
+            sse_started = False
+
+            def send_chunk(data: str):
+                nonlocal sse_started
+                state.faults.fire("server.send")
+                if not sse_started:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
                     self.send_header("Connection", "close")
                     self.send_header("X-Request-Id", rid)
                     self.end_headers()
+                    sse_started = True
+                self.wfile.write(f"data: {data}\r\n\r\n".encode())
+                self.wfile.flush()
 
-                    def send_chunk(data: str):
-                        self.wfile.write(f"data: {data}\r\n\r\n".encode())
-                        self.wfile.flush()
+            def _sse_terminal_error(message: str, err_type: str) -> None:
+                # mid-stream failure: emit a terminal error event so the
+                # client sees the failure, not a silent truncation
+                try:
+                    err = json.dumps(self._error_body(message, err_type, rid))
+                    self.wfile.write(
+                        f"data: {err}\r\n\r\ndata: [DONE]\r\n\r\n".encode()
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                self.close_connection = True
 
+            try:
+                if body.get("stream"):
                     state.complete(body, send_chunk, params=params, request_id=rid)
                     self.close_connection = True
                 else:
@@ -607,20 +782,41 @@ def make_handler(state: ApiState):
                     self._send_json(200, result, request_id=rid)
                 return "200"
             except BrokenPipeError:
-                return "499"  # client went away mid-stream
+                # client went away mid-stream: the slot/batch row was
+                # already released on the way out (engine stream_decode and
+                # complete() run their finally blocks); the socket is dead
+                self.close_connection = True
+                return "499"
+            except AdmissionRejected as e:
+                # raised before any SSE byte (admission precedes decoding)
+                self._send_json(
+                    429, self._error_body(str(e), "overloaded", rid),
+                    request_id=rid,
+                    extra_headers={"Retry-After": str(state.retry_after_s)},
+                )
+                return "429"
+            except ServerDraining as e:
+                self._send_json(
+                    503, self._error_body(str(e), "draining", rid),
+                    request_id=rid,
+                    extra_headers={"Retry-After": str(state.retry_after_s)},
+                )
+                return "503"
+            except DeadlineExceeded as e:
+                state.tel.deadline_exceeded.inc()
+                if sse_started:
+                    _sse_terminal_error(str(e), "deadline_exceeded")
+                else:
+                    self._send_json(
+                        504,
+                        self._error_body(str(e), "deadline_exceeded", rid),
+                        request_id=rid,
+                    )
+                return "504"
             except Exception as e:  # engine failure: surface it, keep serving
                 print(f"🛑 request {rid} failed: {type(e).__name__}: {e}")
-                if body.get("stream"):
-                    # SSE headers are already out — emit a terminal error
-                    # event so the client sees the failure, not a silent
-                    # truncation
-                    try:
-                        err = json.dumps(self._error_body(str(e), "server_error", rid))
-                        self.wfile.write(f"data: {err}\r\n\r\ndata: [DONE]\r\n\r\n".encode())
-                        self.wfile.flush()
-                    except OSError:
-                        pass
-                    self.close_connection = True
+                if sse_started:
+                    _sse_terminal_error(str(e), "server_error")
                 else:
                     self._send_json(
                         500, self._error_body(str(e), "server_error", rid),
@@ -631,6 +827,38 @@ def make_handler(state: ApiState):
     return Handler
 
 
+def drain_then_shutdown(state: ApiState, server, timeout_s: float) -> None:
+    """Wait for every in-flight completion to finish (all slot semaphore
+    permits reacquirable), capped at ``timeout_s``, then stop the HTTP
+    server. Runs on its own thread so the SIGTERM handler returns
+    immediately."""
+    deadline = time.monotonic() + max(timeout_s, 0.0)
+    for _ in range(len(state.slots)):
+        state._free.acquire(timeout=max(deadline - time.monotonic(), 0.001))
+    server.shutdown()
+
+
+def install_sigterm_drain(state: ApiState, server, timeout_s: float = 30.0):
+    """SIGTERM → graceful drain: flip readiness (``/readyz`` 503), stop
+    admitting (new completions get 503 + Retry-After), let in-flight
+    chunks finish, then shut the server down. Returns the installed
+    handler (tests invoke it directly). No-op outside the main thread
+    (signal.signal's constraint)."""
+
+    def handler(signum, frame):
+        state.begin_drain()
+        threading.Thread(
+            target=drain_then_shutdown, args=(state, server, timeout_s),
+            name="dllama-drain", daemon=True,
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        pass  # not the main thread (embedded/test server): caller drains
+    return handler
+
+
 def serve(args) -> None:
     from distributed_llama_tpu.apps.cli import make_engine
 
@@ -638,13 +866,23 @@ def serve(args) -> None:
     # ApiState bind their instrument bundles (bind-once contract)
     if getattr(args, "telemetry", False):
         telemetry.enable()
+    # --faults installs the chaos plan BEFORE the engine/scheduler bind
+    # their hooks (same bind-once contract; docs/ROBUSTNESS.md)
+    spec = getattr(args, "faults", None)
+    if spec:
+        faults.install(faults.parse(spec, seed=getattr(args, "faults_seed", 0)))
+        print(f"⚠️ fault plan active: {spec}")
     engine, tokenizer, sampler = make_engine(args)
     state = ApiState(engine, tokenizer, sampler, args)
     # threaded HTTP front (GET /v1/models and queued POSTs stay responsive);
     # up to --parallel completions run concurrently on their own engine
-    # streams, excess requests queue on the slot semaphore (ApiState._free)
+    # streams, excess requests queue BOUNDEDLY on the slot semaphore
+    # (ApiState._acquire_slot: 429 beyond --admission-queue waiters)
     server = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(state))
     server.daemon_threads = True
+    install_sigterm_drain(
+        state, server, timeout_s=getattr(args, "drain_timeout_s", 30.0)
+    )
     print(f"Server URL: http://127.0.0.1:{args.port}/v1/")
     if telemetry.is_enabled():
         print(f"Metrics:    http://127.0.0.1:{args.port}/metrics")
@@ -674,6 +912,44 @@ def main(argv=None) -> None:
         "requests — near-Bx aggregate tok/s on the HBM-bound decode; "
         "single-chip and --tp backends, --decode device). "
         "--no-batch-decode restores independent per-request dispatches",
+    )
+    # fault tolerance (docs/ROBUSTNESS.md)
+    parser.add_argument(
+        "--admission-queue", type=int, default=None,
+        help="max completion requests queued for a free slot before the "
+        "server answers 429 + Retry-After (default 2x --parallel; the "
+        "alternative is an unbounded queue of burning client timeouts)",
+    )
+    parser.add_argument(
+        "--max-body-bytes", type=int, default=1 << 20,
+        help="request-body size cap; larger Content-Length gets 413 "
+        "without reading the body (default 1 MiB)",
+    )
+    parser.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="default per-request deadline in ms (requests may set their "
+        "own 'deadline_ms'); an expired request ends 504 / an SSE error "
+        "event and its batch row leaves the shared dispatch",
+    )
+    parser.add_argument(
+        "--stall-timeout-s", type=float, default=120.0,
+        help="batched-decode watchdog: a chunk fetch in flight longer than "
+        "this fails the batch cleanly instead of hanging every lane "
+        "(0 disables)",
+    )
+    parser.add_argument(
+        "--drain-timeout-s", type=float, default=30.0,
+        help="SIGTERM drain: max seconds to wait for in-flight completions "
+        "before shutting the listener down",
+    )
+    parser.add_argument(
+        "--faults", type=str, default=None,
+        help="chaos fault-plan spec (or DLLAMA_FAULTS env), e.g. "
+        "'batch.fetch:kind=raise,after=2,count=1' — docs/ROBUSTNESS.md",
+    )
+    parser.add_argument(
+        "--faults-seed", type=int, default=0,
+        help="seed for probabilistic fault rules (p<1)",
     )
     # mode is meaningless here but the shared parser requires it
     argv = argv if argv is not None else None

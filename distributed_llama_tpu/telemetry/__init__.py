@@ -248,6 +248,24 @@ class EngineInstruments:
             "decode chunk (0..1; 1.0 = every slab row in the bucket is a "
             "live request sharing the step's weight reads)",
         )
+        # fault-tolerance surface (ISSUE 3): quarantines, retries, stalls
+        self.rows_quarantined = counter(
+            "dllama_rows_quarantined_total",
+            "Batch rows retired after a failed or corrupted chunk "
+            "(bounded retries exhausted); co-batched rows kept streaming",
+        )
+        batch_retries = counter(
+            "dllama_batch_retries_total",
+            "Batched dispatch/fetch attempts retried after a transient "
+            "failure (bounded, with backoff)",
+            labelnames=("stage",),
+        )
+        self.dispatch_retries = batch_retries.labels(stage="dispatch")
+        self.fetch_retries = batch_retries.labels(stage="fetch")
+        self.watchdog_stalls = counter(
+            "dllama_watchdog_stalls_total",
+            "Hung batched chunks the stall watchdog failed cleanly",
+        )
 
 
 class CollectiveInstruments:
@@ -293,6 +311,22 @@ class ServerInstruments:
         self.queue_wait = histogram(
             "dllama_slot_queue_wait_seconds",
             "Time a completion request waited for a free engine stream slot",
+        )
+        # fault-tolerance surface (ISSUE 3): admission control + deadlines
+        self.admission_rejected = counter(
+            "dllama_admission_rejected_total",
+            "Completion requests rejected 429 because the bounded admission "
+            "queue was full (clients should honor Retry-After)",
+        )
+        self.deadline_exceeded = counter(
+            "dllama_deadline_exceeded_total",
+            "Completion requests ended 504 because their deadline_ms expired "
+            "(queued or mid-stream)",
+        )
+        self.draining = gauge(
+            "dllama_server_draining",
+            "1 while the server is draining (SIGTERM received: no new "
+            "admissions, in-flight completions finishing)",
         )
 
 

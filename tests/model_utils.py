@@ -1,60 +1,14 @@
 """Shared helpers: build random synthetic models, write them as `.m` files.
 
-The analogue of the reference's synthetic-spec golden tests
-(src/llama2-tasks-test.cpp:531-565), with the xorshift weight fill replaced by
-seeded numpy and the hard-coded expected outputs replaced by the NumpyLlama
-oracle in tests/reference_impl.py.
+The implementation lives in ``distributed_llama_tpu.formats.synthetic`` (the
+chaos bench uses the same writer — one copy of the layout/init rules); this
+module keeps the historical test-suite import path.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
-from distributed_llama_tpu.formats.model_file import (
-    ArchType,
-    HiddenAct,
-    ModelFileWriter,
-    ModelSpec,
-    RopeType,
-    tensor_layout,
+from distributed_llama_tpu.formats.synthetic import (  # noqa: F401  (re-export)
+    random_tensors,
+    tiny_spec,
+    write_model_file,
 )
-from distributed_llama_tpu.quants import FloatType
-
-
-def tiny_spec(**overrides) -> ModelSpec:
-    defaults = dict(
-        arch_type=ArchType.LLAMA,
-        dim=32,
-        hidden_dim=64,
-        n_layers=2,
-        n_heads=4,
-        n_kv_heads=2,
-        vocab_size=64,
-        seq_len=24,
-        hidden_act=HiddenAct.SILU,
-        rope_theta=10000.0,
-        rope_type=RopeType.UNKNOWN,
-        weights_float_type=FloatType.F32,
-    )
-    defaults.update(overrides)
-    return ModelSpec(**defaults)
-
-
-def random_tensors(spec: ModelSpec, seed: int = 0) -> dict[str, np.ndarray]:
-    """Random weights keyed by the `.m` layout names, shaped [d_out, d_in]."""
-    rng = np.random.RandomState(seed)
-    out: dict[str, np.ndarray] = {}
-    for e in tensor_layout(spec):
-        if e.name.startswith("rms") or ".rms" in e.name:
-            t = 1.0 + 0.1 * rng.randn(*e.shape)
-        else:
-            t = rng.randn(*e.shape) / np.sqrt(e.shape[-1])
-        out[e.name] = t.astype(np.float32)
-    return out
-
-
-def write_model_file(path: str, spec: ModelSpec, tensors: dict[str, np.ndarray]) -> None:
-    with open(path, "wb") as f:
-        w = ModelFileWriter(f, spec)
-        for e in w.remaining():
-            w.write_tensor(tensors[e.name], e.name)

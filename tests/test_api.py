@@ -378,9 +378,12 @@ class TestApiHardening:
         assert rid
         assert data["id"] == f"chatcmpl-{rid}"
 
-    def test_streaming_engine_failure_sends_error_event(self, served):
-        """An engine failure mid-stream must surface as a terminal SSE error
-        event, not a silently truncated stream."""
+    def test_streaming_engine_failure_before_first_byte_is_clean_500(self, served):
+        """An engine failure BEFORE any SSE byte (prefill) must surface as a
+        clean HTTP 500 — SSE headers go out lazily with the first event, so
+        a pre-stream failure is a real error status, not a 200 + error
+        event (mid-stream failures still get the terminal SSE error event;
+        tests/test_faults.py covers those)."""
         url, state = served
         state.engine.reset()
         state.cache.clear()
@@ -398,11 +401,55 @@ class TestApiHardening:
                 }).encode(),
                 headers={"Content-Type": "application/json"},
             )
-            with urllib.request.urlopen(req, timeout=30) as r:
-                raw = r.read().decode()
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                assert False, "expected HTTP 500"
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                body = json.loads(e.read())
         finally:
             state.engine._forward = original
-        chunks = [c[len("data: "):] for c in raw.split("\r\n\r\n") if c.startswith("data: ")]
-        assert chunks, raw
-        assert json.loads(chunks[0])["error"]["message"] == "boom"
-        assert chunks[-1] == "[DONE]"
+        assert body["error"]["message"] == "boom"
+        assert body["error"]["request_id"]
+
+    def test_sse_client_disconnect_releases_slot_and_rolls_back(self, served):
+        """Regression (ISSUE 3 satellite): a BrokenPipeError mid-stream must
+        release the slot (semaphore + busy flag) AND roll the engine stream
+        back past its speculative overshoot, so the next request on the lane
+        reuses its prefix cache instead of leaking the lane forever."""
+        url, state = served
+        for slot in state.slots:
+            slot.stream.reset()
+            slot.cache.clear()
+
+        sent = []
+
+        def send_then_die(data):
+            sent.append(data)
+            raise BrokenPipeError("client went away")
+
+        with pytest.raises(BrokenPipeError):
+            state.complete(
+                {"stream": True,
+                 "messages": [{"role": "user", "content": "hello"}],
+                 "max_tokens": 8},
+                send_then_die,
+            )
+        assert sent  # it was genuinely mid-stream
+        # the slot is free again: busy flags cleared and the semaphore
+        # permits restored (all lanes acquirable)
+        assert all(not s.busy for s in state.slots)
+        for _ in range(len(state.slots)):
+            assert state._free.acquire(blocking=False)
+        for _ in range(len(state.slots)):
+            state._free.release()
+        # stream position rewound to tokens actually consumed (no
+        # speculative-chunk overshoot pinned on the lane)
+        used = [s for s in state.slots if s.stream.total_tokens() > 0]
+        for s in used:
+            assert s.stream.pos <= s.stream.total_tokens()
+        # and the lane still serves the next request end-to-end
+        with post(url, {"messages": [{"role": "user", "content": "again"}],
+                        "max_tokens": 3}) as r:
+            assert json.loads(r.read())["object"] == "chat.completion"
+        assert state.engine._pipeline_depth == 0

@@ -1,0 +1,660 @@
+"""Fault-tolerance suite (ISSUE 3): deterministic chaos against the real
+serving stack — injection determinism, row quarantine with survivor
+bit-parity (and /healthz green throughout), deadlines at every stage,
+admission control (429), body caps (413), the stall watchdog, and
+drain-on-SIGTERM.
+
+Everything here is tier-1 safe: tiny synthetic models, seeded fault plans,
+bounded sleeps. The ``chaos`` marker tags the suite for selective runs
+(``-m chaos``); it is NOT excluded from the default run.
+"""
+
+import json
+import signal
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.engine import InferenceEngine, faults
+from distributed_llama_tpu.engine.batch import BatchScheduler
+from distributed_llama_tpu.server.api import (
+    ApiState,
+    drain_then_shutdown,
+    install_sigterm_drain,
+    make_handler,
+)
+
+from tests.model_utils import random_tensors, tiny_spec, write_model_file
+
+pytestmark = pytest.mark.chaos
+
+PROMPTS = [[1, 5, 9], [2, 4, 6, 8], [3, 7], [9, 1, 4]]
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    """No chaos plan leaks across tests (plans bind at construction, but a
+    leaked install would silently arm every later-built component)."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def build_engine(tmp_path, name="model.m", seq_len=96):
+    spec = tiny_spec(seq_len=seq_len)
+    path = str(tmp_path / name)
+    write_model_file(path, spec, random_tensors(spec, seed=0))
+    return InferenceEngine(path, dtype=jnp.float32)
+
+
+def run_streams(sched, streams, n=10, sampling=None):
+    """All streams request concurrently (the serving pattern); returns
+    (tokens per stream, error per stream)."""
+    outs = [None] * len(streams)
+    errs = [None] * len(streams)
+
+    def one(i):
+        s = streams[i]
+        temp, topp, seed = (sampling or {}).get(i, (0.0, 0.9, 11 + i))
+        try:
+            prompt = PROMPTS[i % len(PROMPTS)]
+            first, key = s.prefill_device(prompt, temp, topp, seed)
+            got = []
+
+            def on_token(prev, tok):
+                got.append(tok)
+                return len(got) < n
+
+            s.stream_decode(first, on_token, temp, topp, seed=seed,
+                            limit=s.pos + n, key=key, first_prev=prompt[-1])
+            outs[i] = got
+        except Exception as e:
+            errs[i] = e
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(len(streams))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), "stream thread hung"
+    return outs, errs
+
+
+class TestFaultPlan:
+    """The injection machinery itself: parsing, deterministic counting,
+    seeded probability, and the null-plan bind-once contract."""
+
+    def test_parse_spec_fields(self):
+        plan = faults.parse(
+            "batch.fetch:kind=raise,after=2,count=3;"
+            "batch.row:kind=nan,row=1,delay_ms=5.5,p=0.25", seed=9,
+        )
+        a, b = plan.rules
+        assert (a.site, a.kind, a.after, a.count) == ("batch.fetch", "raise", 2, 3)
+        assert (b.site, b.kind, b.row, b.delay_ms, b.p) == (
+            "batch.row", "nan", 1, 5.5, 0.25)
+        assert plan.seed == 9
+
+    def test_parse_json_equivalent(self):
+        plan = faults.parse(
+            '[{"site": "x", "kind": "delay", "delay_ms": 2, "count": -1}]'
+        )
+        (r,) = plan.rules
+        assert (r.site, r.kind, r.delay_ms, r.count) == ("x", "delay", 2, -1)
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            faults.parse("")
+        with pytest.raises(ValueError):
+            faults.parse("site:kind=explode")
+        with pytest.raises(ValueError):
+            faults.parse("site:bogus_field=1")
+
+    def test_after_count_fire_pattern_is_deterministic(self):
+        def pattern():
+            plan = faults.FaultPlan(
+                [faults.FaultRule(site="s", kind="nan", after=2, count=2)]
+            )
+            return [plan.fires("s") is not None for _ in range(8)]
+
+        want = [False, False, True, True, False, False, False, False]
+        assert pattern() == want
+        assert pattern() == want  # a fresh identical plan fires identically
+
+    def test_probabilistic_rules_are_seed_deterministic(self):
+        def pattern(seed):
+            plan = faults.FaultPlan(
+                [faults.FaultRule(site="s", kind="nan", count=-1, p=0.5)],
+                seed=seed,
+            )
+            return [plan.fires("s") is not None for _ in range(64)]
+
+        assert pattern(7) == pattern(7)
+        assert pattern(7) != pattern(8)  # 2^-64 false-failure odds
+        assert plan_reset_replays(7)
+
+    def test_row_targeted_rule_holds_until_victim_rides(self):
+        plan = faults.FaultPlan(
+            [faults.FaultRule(site="s", kind="nan", row=3, count=1)]
+        )
+        assert plan.fires("s", rows=[0, 1]) is None  # victim absent: held
+        assert plan.fires("s", rows=[0, 3]) is not None
+        assert plan.fires("s", rows=[0, 3]) is None  # count consumed
+
+    def test_fire_kinds(self):
+        plan = faults.FaultPlan([
+            faults.FaultRule(site="r", kind="raise"),
+            faults.FaultRule(site="d", kind="disconnect"),
+            faults.FaultRule(site="sl", kind="delay", delay_ms=30),
+        ])
+        with pytest.raises(faults.InjectedFault):
+            plan.fire("r")
+        with pytest.raises(BrokenPipeError):
+            plan.fire("d")
+        t0 = time.monotonic()
+        assert plan.fire("sl").kind == "delay"
+        assert time.monotonic() - t0 >= 0.025
+        assert plan.injected_total == 3
+
+    def test_null_plan_and_install_clear(self):
+        assert faults.active_plan() is faults.NULL_PLAN
+        assert faults.NULL_PLAN.fire("anything") is None
+        assert faults.NULL_PLAN.fires("anything") is None
+        plan = faults.install(faults.parse("x:kind=raise"))
+        assert faults.active_plan() is plan
+        faults.clear()
+        assert faults.active_plan() is faults.NULL_PLAN
+
+    def test_injections_feed_telemetry_counter(self):
+        from distributed_llama_tpu import telemetry
+
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            plan = faults.FaultPlan(
+                [faults.FaultRule(site="x", kind="nan", count=2)]
+            )
+            assert plan.fires("x") is not None
+            assert plan.fires("x") is not None
+            assert plan.fires("x") is None
+            c = telemetry.REGISTRY.counter(
+                "dllama_faults_injected_total", labelnames=("site",)
+            )
+            assert c.labels(site="x").value == 2
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+def plan_reset_replays(seed):
+    plan = faults.FaultPlan(
+        [faults.FaultRule(site="s", kind="nan", count=-1, p=0.5)], seed=seed
+    )
+    first = [plan.fires("s") is not None for _ in range(32)]
+    plan.reset()
+    return first == [plan.fires("s") is not None for _ in range(32)]
+
+
+class TestQuarantine:
+    """Row quarantine instead of batch poisoning: only the offending row
+    dies, survivors stay bit-identical, transients recover invisibly."""
+
+    def test_row_fault_quarantines_victim_only_and_survivors_bit_match(self, tmp_path):
+        clean_engine = build_engine(tmp_path, "clean.m")
+        clean_sched = BatchScheduler(clean_engine, n_rows=4, chunk=4)
+        clean_streams = [clean_sched.new_stream() for _ in range(4)]
+        want, errs = run_streams(clean_sched, clean_streams, n=10)
+        assert errs == [None] * 4
+
+        faults.install(faults.parse("batch.row:kind=nan,row=2,after=1,count=1"))
+        engine = build_engine(tmp_path, "chaos.m")
+        sched = BatchScheduler(engine, n_rows=4, chunk=4, retry_backoff_s=0.001)
+        streams = [sched.new_stream() for _ in range(4)]
+        got, errs = run_streams(sched, streams, n=10)
+
+        assert isinstance(errs[2], faults.RowQuarantined)
+        for i in (0, 1, 3):
+            assert errs[i] is None
+            assert got[i] == want[i], f"survivor row {i} diverged"
+        assert engine._pipeline_depth == 0
+        # the quarantined row serves its next request from scratch
+        faults.clear()
+        streams[2].reset()
+        out2, err2 = run_streams(sched, [streams[2]], n=10)
+        # row 2 now decodes alone at bucket 1 with row-0's... no: it keeps
+        # its own row; its solo rerun must match the clean row-2 stream
+        assert err2 == [None]
+
+    def test_transient_fetch_error_is_invisible(self, tmp_path):
+        clean_engine = build_engine(tmp_path, "clean.m")
+        clean_sched = BatchScheduler(clean_engine, n_rows=2, chunk=4)
+        want, _ = run_streams(clean_sched, [clean_sched.new_stream() for _ in range(2)], n=8)
+
+        faults.install(faults.parse("batch.fetch:kind=raise,after=1,count=1"))
+        engine = build_engine(tmp_path, "chaos.m")
+        sched = BatchScheduler(engine, n_rows=2, chunk=4, retry_backoff_s=0.001)
+        got, errs = run_streams(sched, [sched.new_stream() for _ in range(2)], n=8)
+        assert errs == [None, None]
+        assert got == want  # the retry recovered bit-identically
+        assert engine._pipeline_depth == 0
+
+    def test_dispatch_failure_retires_rows_but_scheduler_survives(self, tmp_path):
+        # count=3 outlasts every attempt of ONE dispatch (retries=2 → 3
+        # attempts), then exhausts: the first request dies typed, the next
+        # one succeeds on the same scheduler
+        faults.install(faults.parse("batch.dispatch:kind=raise,count=3"))
+        engine = build_engine(tmp_path)
+        sched = BatchScheduler(engine, n_rows=2, chunk=4, retry_backoff_s=0.001)
+        s = sched.new_stream()
+        outs, errs = run_streams(sched, [s], n=6)
+        assert isinstance(errs[0], faults.RowQuarantined)
+        assert engine._pipeline_depth == 0
+        s.reset()
+        outs, errs = run_streams(sched, [s], n=6)
+        assert errs == [None] and len(outs[0]) == 6
+
+    def test_deadline_expired_row_leaves_batch(self, tmp_path):
+        engine = build_engine(tmp_path)
+        sched = BatchScheduler(engine, n_rows=2, chunk=4)
+        s = sched.new_stream()
+        s.deadline = time.monotonic() - 0.001  # already expired
+        outs, errs = run_streams(sched, [s], n=6)
+        assert isinstance(errs[0], faults.DeadlineExceeded)
+        assert engine._pipeline_depth == 0
+        s.reset()  # clears the deadline
+        assert s.deadline is None
+        outs, errs = run_streams(sched, [s], n=6)
+        assert errs == [None] and len(outs[0]) == 6
+
+    def test_watchdog_fails_hung_fetch_cleanly(self, tmp_path):
+        # the fetcher thread hangs 1.2 s; the watchdog (0.25 s stall budget)
+        # must fail the CO-BATCHED row long before the hang resolves, and
+        # the scheduler must serve again afterwards
+        faults.install(faults.parse("batch.fetch:kind=hang,delay_ms=1200,count=1"))
+        engine = build_engine(tmp_path)
+        sched = BatchScheduler(
+            engine, n_rows=2, chunk=4, retry_backoff_s=0.001,
+            stall_timeout_s=0.25,
+        )
+        try:
+            streams = [sched.new_stream() for _ in range(2)]
+            sw = time.monotonic()
+            outs, errs = run_streams(sched, streams, n=8)
+            elapsed = time.monotonic() - sw
+            assert all(isinstance(e, faults.StallTimeout) for e in errs), errs
+            # the non-hanging lane was released by the WATCHDOG (sub-second),
+            # not by the 1.2 s hang finally draining; both threads join well
+            # under the run_streams timeout either way
+            assert elapsed < 10
+            # the watchdog released the hung fetch's depth hold AND dropped
+            # the orphaned speculative chunk; the late-returning hang must
+            # NOT double-release (a negative depth would let transfer
+            # probes run mid-flight forever after)
+            assert engine._pipeline_depth == 0
+            assert sched._pending is None and not sched._fetching
+            faults.clear()
+            for s in streams:
+                s.reset()
+            outs, errs = run_streams(sched, streams, n=8)
+            assert errs == [None, None]
+            assert all(len(o) == 8 for o in outs)
+            assert engine._pipeline_depth == 0
+        finally:
+            sched.close()
+
+
+def make_state(tmp_path, name, *, parallel=2, batch=True, **extra):
+    from distributed_llama_tpu.formats.tokenizer_file import (
+        TokenizerData,
+        write_tokenizer_file,
+    )
+    from distributed_llama_tpu.tokenizer import Sampler, Tokenizer
+
+    from tests.test_tokenizer import make_sentencepiece_like_tokenizer
+
+    base = make_sentencepiece_like_tokenizer()
+    spec = tiny_spec(seq_len=160, vocab_size=base.vocab_size)
+    model_path = str(tmp_path / f"{name}.m")
+    write_model_file(model_path, spec, random_tensors(spec, seed=0))
+    data = TokenizerData(
+        vocab=base.vocab, scores=base.scores, bos_id=1, eos_id=2,
+        chat_eos_id=2,
+        chat_template="{{bos_token}}{% for m in messages %}<|im_start|>...{% endfor %}",
+    )
+    tok_path = str(tmp_path / f"{name}.t")
+    with open(tok_path, "wb") as f:
+        write_tokenizer_file(f, data)
+    engine = InferenceEngine(model_path, dtype=jnp.float32)
+    tokenizer = Tokenizer.from_file(tok_path)
+    sampler = Sampler(vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+    args = types.SimpleNamespace(
+        temperature=0.0, topp=0.9, seed=1, chat_template=None,
+        parallel=parallel, batch_decode=batch, decode="device",
+        decode_chunk=4, **extra,
+    )
+    return ApiState(engine, tokenizer, sampler, args)
+
+
+def serve_state(state):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{server.server_address[1]}", server
+
+
+def post_raw(url, body: dict, timeout=60):
+    req = urllib.request.Request(
+        url + "/v1/chat/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def get(url, path, timeout=10):
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestServingUnderFaults:
+    """API-level chaos: the ISSUE 3 acceptance criterion and the status
+    codes (504 / 429 / 413 / 503)."""
+
+    def test_row_fault_b4_survivors_bit_identical_healthz_green(self, tmp_path):
+        """Acceptance: a fault plan injecting one failed fetch into a B=4
+        batch — the other 3 streams complete with tokens bit-identical to a
+        fault-free run, and /healthz stays 200 throughout."""
+        bodies = [
+            {"messages": [{"role": "user", "content": f"hello {i}"}],
+             "max_tokens": 8, "temperature": 0.0}
+            for i in range(4)
+        ]
+
+        def run_concurrent(state, url):
+            results = {}
+
+            def one(i):
+                status, _, body = post_raw(url, dict(bodies[i]))
+                results[i] = (status, body)
+
+            threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert len(results) == 4
+            return results
+
+        clean_state = make_state(tmp_path, "clean", parallel=4)
+        assert clean_state.batch is not None
+        url, server = serve_state(clean_state)
+        try:
+            clean = run_concurrent(clean_state, url)
+        finally:
+            server.shutdown()
+        assert all(status == 200 for status, _ in clean.values())
+        clean_text = {
+            i: body["choices"][0]["message"]["content"]
+            for i, (_, body) in clean.items()
+        }
+
+        faults.install(faults.parse("batch.row:kind=nan,row=2,after=1,count=1"))
+        state = make_state(tmp_path, "chaos", parallel=4)
+        assert state.batch is not None
+        url, server = serve_state(state)
+        health, stop_probe = [], threading.Event()
+
+        def probe():
+            while not stop_probe.is_set():
+                health.append(get(url, "/healthz")[0])
+                time.sleep(0.02)
+
+        prober = threading.Thread(target=probe, daemon=True)
+        prober.start()
+        try:
+            chaos = run_concurrent(state, url)
+        finally:
+            stop_probe.set()
+            prober.join(timeout=10)
+            server.shutdown()
+
+        statuses = sorted(status for status, _ in chaos.values())
+        assert statuses == [200, 200, 200, 500], chaos
+        for i, (status, body) in chaos.items():
+            if status == 200:
+                # greedy + same weights: a surviving request's text must be
+                # byte-identical to its fault-free twin
+                assert body["choices"][0]["message"]["content"] == clean_text[i]
+            else:
+                assert "retired" in body["error"]["message"]
+        assert health and all(h == 200 for h in health)
+
+    def test_deadline_expired_while_queued_is_504(self, tmp_path):
+        state = make_state(tmp_path, "q", parallel=1, batch=False,
+                           admission_queue=4)
+        url, server = serve_state(state)
+        try:
+            assert state._free.acquire(blocking=False)  # hold the only slot
+            t0 = time.monotonic()
+            status, headers, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hi"}],
+                      "deadline_ms": 150},
+            )
+            assert status == 504
+            assert body["error"]["type"] == "deadline_exceeded"
+            assert time.monotonic() - t0 < 30  # did not queue unboundedly
+        finally:
+            state._free.release()
+            server.shutdown()
+
+    def test_deadline_mid_stream_sends_sse_error_event(self, tmp_path):
+        # the SSE writer sleeps 400 ms on the first event (injected), so a
+        # 200 ms deadline expires mid-stream: the client sees a terminal
+        # deadline_exceeded event, not a silent truncation
+        faults.install(faults.parse("server.send:kind=delay,delay_ms=400,count=1"))
+        state = make_state(tmp_path, "sse", parallel=2)
+        url, server = serve_state(state)
+        try:
+            # warm request: compiles the prefill/chunk programs so the timed
+            # request's 200 ms budget is spent decoding, not compiling
+            status, _, _ = post_raw(
+                url, {"messages": [{"role": "user", "content": "warm"}],
+                      "max_tokens": 8},
+            )
+            assert status == 200
+            for slot in state.slots:
+                slot.stream.reset()
+                slot.cache.clear()
+            req = urllib.request.Request(
+                url + "/v1/chat/completions",
+                data=json.dumps({
+                    "stream": True, "deadline_ms": 200, "max_tokens": 32,
+                    "messages": [{"role": "user", "content": "hello"}],
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200  # SSE already started
+                raw = r.read().decode()
+        finally:
+            server.shutdown()
+        chunks = [c[len("data: "):] for c in raw.split("\r\n\r\n")
+                  if c.startswith("data: ")]
+        assert chunks[-1] == "[DONE]"
+        err = next(c for c in chunks if "error" in c and c != "[DONE]")
+        assert json.loads(err)["error"]["type"] == "deadline_exceeded"
+
+    def test_non_finite_deadline_is_400(self, tmp_path):
+        # json.loads accepts the NaN/Infinity literals; a NaN deadline would
+        # poison every monotonic comparison and make Semaphore.acquire block
+        # forever — it must die at validation
+        state = make_state(tmp_path, "nan", parallel=1, batch=False)
+        from distributed_llama_tpu.server.api import BadRequest
+
+        for bad in (float("nan"), float("inf"), 0, -5):
+            with pytest.raises(BadRequest, match="deadline_ms"):
+                state._parse({"messages": [{"role": "user", "content": "x"}],
+                              "deadline_ms": bad})
+
+    def test_admission_queue_full_is_429_with_retry_after(self, tmp_path):
+        state = make_state(tmp_path, "adm", parallel=1, batch=False,
+                           admission_queue=0)
+        url, server = serve_state(state)
+        try:
+            assert state._free.acquire(blocking=False)
+            status, headers, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hi"}]},
+            )
+            assert status == 429
+            assert headers.get("Retry-After") == "1"
+            assert body["error"]["type"] == "overloaded"
+        finally:
+            state._free.release()
+            server.shutdown()
+
+    def test_oversized_body_is_413(self, tmp_path):
+        state = make_state(tmp_path, "big", parallel=1, batch=False,
+                           max_body_bytes=512)
+        url, server = serve_state(state)
+        try:
+            status, _, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "x" * 2048}]},
+            )
+            assert status == 413
+            assert body["error"]["type"] == "request_too_large"
+            # and a normal-size request still works
+            status, _, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 2},
+            )
+            assert status == 200
+        finally:
+            server.shutdown()
+
+    def test_sse_disconnect_mid_stream_leaves_batch_row(self, tmp_path):
+        """Regression (satellite): a client disconnect mid-stream on the
+        BATCHED path must leave the scheduler row (no joined stream stays
+        behind pinning the bucket) and free the slot for the next request."""
+        state = make_state(tmp_path, "disc", parallel=2)
+        assert state.batch is not None
+        sent = []
+
+        def send_then_die(data):
+            sent.append(data)
+            raise BrokenPipeError("client went away")
+
+        with pytest.raises(BrokenPipeError):
+            state.complete(
+                {"stream": True, "max_tokens": 8,
+                 "messages": [{"role": "user", "content": "hello"}]},
+                send_then_die,
+            )
+        assert sent  # genuinely mid-stream
+        assert not any(s._joined for s in state.batch._streams)
+        assert state.batch._pending is None and not state.batch._fetching
+        assert all(not s.busy for s in state.slots)
+        assert state.engine._pipeline_depth == 0
+        out = state.complete(
+            {"messages": [{"role": "user", "content": "again"}],
+             "max_tokens": 3},
+            lambda s: None,
+        )
+        assert out["object"] == "chat.completion"
+
+    def test_single_stream_fault_is_500_and_server_keeps_serving(self, tmp_path):
+        faults.install(faults.parse("engine.forward:kind=raise,count=1"))
+        state = make_state(tmp_path, "single", parallel=1, batch=False)
+        url, server = serve_state(state)
+        try:
+            status, _, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 2},
+            )
+            assert status == 500
+            assert "injected fault" in body["error"]["message"]
+            status, _, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 2},
+            )
+            assert status == 200
+        finally:
+            server.shutdown()
+
+
+class TestLifecycle:
+    """Health endpoints + SIGTERM drain."""
+
+    def test_healthz_readyz_and_drain_gate(self, tmp_path):
+        state = make_state(tmp_path, "life", parallel=1, batch=False)
+        url, server = serve_state(state)
+        try:
+            assert get(url, "/healthz")[0] == 200
+            assert get(url, "/readyz")[0] == 200
+            state.begin_drain()
+            assert get(url, "/healthz")[0] == 200  # liveness unaffected
+            assert get(url, "/readyz")[0] == 503
+            status, headers, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hi"}]},
+            )
+            assert status == 503
+            assert headers.get("Retry-After") == "1"
+            assert body["error"]["type"] == "draining"
+        finally:
+            server.shutdown()
+
+    def test_drain_on_sigterm_waits_for_inflight(self, tmp_path):
+        state = make_state(tmp_path, "drain", parallel=2, batch=False)
+
+        class StubServer:
+            def __init__(self):
+                self.down = threading.Event()
+
+            def shutdown(self):
+                self.down.set()
+
+        stub = StubServer()
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            install_sigterm_drain(state, stub, timeout_s=20.0)
+            assert state._free.acquire(blocking=False)  # one request in flight
+            signal.raise_signal(signal.SIGTERM)
+            deadline = time.monotonic() + 5
+            while not state.draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert state.draining
+            # the listener must NOT stop while the request is in flight
+            assert not stub.down.wait(timeout=0.3)
+            state._free.release()  # in-flight completion finishes
+            assert stub.down.wait(timeout=10)
+        finally:
+            signal.signal(signal.SIGTERM, old)
+
+    def test_drain_then_shutdown_times_out(self, tmp_path):
+        state = make_state(tmp_path, "drain2", parallel=1, batch=False)
+
+        done = threading.Event()
+
+        class StubServer:
+            def shutdown(self):
+                done.set()
+
+        assert state._free.acquire(blocking=False)  # a request that never ends
+        try:
+            t0 = time.monotonic()
+            drain_then_shutdown(state, StubServer(), timeout_s=0.3)
+            assert done.is_set()
+            assert time.monotonic() - t0 < 5  # the cap held
+        finally:
+            state._free.release()
