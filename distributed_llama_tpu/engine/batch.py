@@ -32,6 +32,17 @@ Architecture
   single-sequence forward on the stream's slab row (extracted and
   re-inserted inside the jitted program; the donated slab aliases in
   place), reusing the whole blocked-attention/i8/bucketing machinery.
+  Long prompts dispatch in ``prefill_chunk``-token pieces with the
+  scheduler lock released between them, so other rows' decode chunks
+  interleave with a long prefill (Sarathi-style; ISSUE 4 satellite).
+* With ``prefix_cache=True`` the scheduler also owns a page pool
+  (``llama.init_page_pool``) and a radix tree over token blocks
+  (``engine/prefix_cache.py``): an admission prefill (row position 0)
+  copies its matched prefix pages out of the tree and prefills only the
+  unmatched suffix; completed full pages are published back. Copy
+  semantics keep rows and tree pages disjoint, so quarantine/reset of a
+  row can never free shared pages — and a prefix-hit stream is
+  bit-identical to the cold prefill (tests/test_prefix_cache.py).
 * Per-row PRNG keys, temperatures and top-p thread through the batched
   program, so a row's token stream is bit-identical to the single-stream
   chunked decode for the same per-row key (tests/test_batch_decode.py) and
@@ -60,7 +71,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_llama_tpu.engine import faults
-from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket
+from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket, next_pow2
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 from distributed_llama_tpu.ops import kv_cache as kvc
@@ -70,10 +81,43 @@ from distributed_llama_tpu.telemetry import Stopwatch
 def decode_bucket(n: int, b_max: int) -> int:
     """Power-of-two row bucket covering rows 0..n-1 (capped at b_max): one
     compiled batched program per bucket, holes masked inactive."""
-    b = 1
-    while b < n:
-        b *= 2
-    return min(b, b_max)
+    return min(next_pow2(n), b_max)
+
+
+def _page_bucket(n: int) -> int:
+    """Power-of-two padding for page-id arrays: one compiled gather/publish
+    program per bucket, padded entries dropped by out-of-bounds indices."""
+    return next_pow2(n)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def _gather_pages(page: int, slab, pool, page_ids, dest_page, row):
+    """Copy pool pages ``page_ids`` into slab row ``row`` at page slots
+    ``dest_page`` across every layer (the admission-time prefix bind:
+    correctness-first copy — the row gets its OWN bytes, so nothing it does
+    later can touch the immutable tree pages). The donated slab aliases in
+    place; the pool is read-only here."""
+    return [
+        (
+            kvc.gather_pages_to_row(sk, pk, page_ids, dest_page, row, page),
+            kvc.gather_pages_to_row(sv, pv, page_ids, dest_page, row, page),
+        )
+        for (sk, sv), (pk, pv) in zip(slab, pool)
+    ]
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _publish_pages(page: int, slab, pool, page_ids, src_page, row):
+    """Copy slab row ``row``'s page slots ``src_page`` into pool pages
+    ``page_ids`` across every layer (the post-prefill publish). The donated
+    pool aliases in place; the slab is read-only here."""
+    return [
+        (
+            kvc.publish_row_pages(pk, sk, row, src_page, page_ids, page),
+            kvc.publish_row_pages(pv, sv, row, src_page, page_ids, page),
+        )
+        for (sk, sv), (pk, pv) in zip(slab, pool)
+    ]
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -126,6 +170,10 @@ class BatchStream:
         # layer: the scheduler retires an expired row BETWEEN chunks and its
         # next_token raises DeadlineExceeded (ISSUE 3)
         self.deadline: float | None = None
+        # per-request prefix-cache opt-out (the API body's `cache: off`):
+        # False skips BOTH the admission match and the post-prefill publish
+        # for this row (ISSUE 4); serving restores True between requests
+        self.prefix_cache_enabled = True
         # a chunk failure retires ONLY this row (faults.RowQuarantined /
         # StallTimeout / DeadlineExceeded, set by the scheduler under its
         # lock); next_token raises it, surviving co-batched rows keep
@@ -159,6 +207,7 @@ class BatchStream:
         self._pending_prefill_entry = None
         self._fetch_error = None
         self.deadline = None
+        self.prefix_cache_enabled = True
 
     def rollback(self, pos: int) -> None:
         """Rewind to ``pos`` (prefix-cache reuse / early-stop contract).
@@ -183,8 +232,8 @@ class BatchStream:
         engine = self.engine
         sw = Stopwatch()
         with engine._tel.span("prefill", tokens=n, pos=self.pos, batch_row=self.row):
-            logits = self.scheduler._prefill_row(self, tokens)
-            out = np.asarray(logits[n - 1])
+            logits, last = self.scheduler._prefill_row(self, tokens)
+            out = np.asarray(logits[last])
         entry = engine._split_stats(sw.elapsed_ms(), n_tokens=n)
         self.stats.append(entry)
         if engine._tel.enabled:
@@ -207,11 +256,11 @@ class BatchStream:
             with engine._tel.span(
                 "prefill_dispatch", tokens=n, pos=self.pos, batch_row=self.row
             ):
-                logits = self.scheduler._prefill_row(self, tokens)
+                logits, last = self.scheduler._prefill_row(self, tokens)
                 key = jax.random.PRNGKey(seed)
                 key, sub = jax.random.split(key)
                 token = engine._sample_row(
-                    logits, jnp.int32(n - 1), sub,
+                    logits, jnp.int32(last), sub,
                     jnp.float32(temperature), jnp.float32(topp),
                 )
             entry = engine._split_stats(sw.elapsed_ms(), n_tokens=n)
@@ -356,6 +405,10 @@ class BatchScheduler:
         retries: int = 2,
         retry_backoff_s: float = 0.05,
         stall_timeout_s: float | None = None,
+        prefix_cache: bool = False,
+        kv_pages: int | None = None,
+        page_size: int = 64,
+        prefill_chunk: int = 0,
     ):
         tp_engine = engine._tp_engine
         if tp_engine is not None and not hasattr(tp_engine, "batched_decode_chunk"):
@@ -368,6 +421,49 @@ class BatchScheduler:
         self.engine = engine
         self.b_max = n_rows
         self.chunk = int(chunk)
+        # Sarathi-style chunked prefill (ISSUE 4 satellite): a long prompt
+        # is dispatched in prefill_chunk-token pieces with the scheduler
+        # lock RELEASED between dispatches, so decode chunks for other rows
+        # interleave instead of stalling behind the whole prompt. 0 = one
+        # monolithic dispatch (the pre-ISSUE-4 behavior).
+        self.prefill_chunk = max(0, int(prefill_chunk or 0))
+        # radix-tree prefix cache over pool pages (ISSUE 4 tentpole): an
+        # admission prefill reuses published KV pages for its matched
+        # prompt prefix and prefills only the unmatched suffix
+        self._prefix = None
+        self._pool = None
+        if prefix_cache:
+            # misconfiguration disables ONLY the prefix cache (with the
+            # real reason printed) — it must never take batched decode
+            # down with it (a raised ValueError here would be caught by
+            # the server's backend-fallback handler and silently cost the
+            # whole one-weight-read-per-step serving path)
+            page_ok = 1 <= page_size <= engine.cfg.seq_len
+            if kv_pages is None and page_ok:
+                # default HBM budget: one slab's worth of pages (the pool
+                # roughly doubles KV memory; size it explicitly with
+                # --kv-pages on deployments near the HBM limit)
+                kv_pages = max(1, n_rows * (engine.cfg.seq_len // page_size))
+            if tp_engine is not None:
+                print(
+                    "⚠️ prefix cache disabled: the page pool is single-chip "
+                    "only for now (zero-copy sharded pages are the "
+                    "documented follow-up, docs/PERF.md)"
+                )
+            elif not page_ok:
+                print(
+                    f"⚠️ prefix cache disabled: page size {page_size} must "
+                    f"be in [1, seq_len {engine.cfg.seq_len}]"
+                )
+            elif kv_pages < 1:
+                print("⚠️ prefix cache disabled: --kv-pages 0")
+            else:
+                from distributed_llama_tpu.engine.prefix_cache import PrefixCache
+
+                self._prefix = PrefixCache(kv_pages, page_size)
+                self._pool = llama.init_page_pool(
+                    engine.cfg, kv_pages, page_size, dtype=engine.cache_dtype
+                )
         # fault tolerance (ISSUE 3): bounded retry with exponential backoff
         # for transient dispatch/fetch failures, an optional stall watchdog,
         # and the bind-once fault-injection plan (NULL_PLAN when no chaos
@@ -468,6 +564,13 @@ class BatchScheduler:
     # ------------------------------------------------------------------
 
     def _prefill_row(self, stream: BatchStream, tokens: np.ndarray):
+        """Prefill ``tokens`` into ``stream``'s slab row. On an ADMISSION
+        prefill (row position 0, prefix cache active, request not opted
+        out) the radix tree is consulted first: matched prefix pages are
+        gathered into the row and only the unmatched suffix is dispatched;
+        the completed prefill's full pages are then published back into the
+        tree. Returns ``(logits, last)`` — the final dispatch's device
+        logits and the index of the last REAL token's row within them."""
         engine = self.engine
         n = tokens.shape[0]
         if n == 0:
@@ -476,24 +579,164 @@ class BatchScheduler:
             raise ValueError(
                 f"context overflow: pos {stream.pos} + {n} > {engine.cfg.seq_len}"
             )
-        bucket = _prefill_bucket(n)
-        if stream.pos + bucket > engine.cfg.seq_len:
-            bucket = n  # exact-length compile near the context limit
-        padded = np.zeros(bucket, dtype=np.int32)
-        padded[:n] = tokens
+        admission = (
+            self._prefix is not None
+            and stream.pos == 0
+            and stream.prefix_cache_enabled
+        )
+        chain: list = []
+        suffix = tokens
+        if admission:
+            chain = self._gather_matched(stream, tokens)
+            if chain:
+                suffix = tokens[len(chain) * self._prefix.page :]
+        try:
+            logits, last = self._dispatch_prefill_chunks(stream, suffix)
+        except BaseException:
+            # a failed suffix prefill must not leave the matched chain
+            # pinned against eviction forever
+            if chain:
+                with self._cond:
+                    self._prefix.release(chain)
+            raise
+        if admission:
+            self._publish_row(stream, tokens, chain)
+        return logits, last
+
+    def _dispatch_prefill_chunks(self, stream: BatchStream, tokens: np.ndarray):
+        """Dispatch a (suffix-offset) prompt at ``stream.pos``, chunked at
+        ``prefill_chunk`` tokens: the scheduler lock is released between
+        chunk dispatches so other rows' decode chunks interleave with a
+        long prefill (Sarathi-style) instead of queueing behind the whole
+        prompt. Returns (device logits of the final dispatch, index of the
+        last real token's logits row)."""
+        engine = self.engine
+        n = tokens.shape[0]
+        step = self.prefill_chunk if self.prefill_chunk > 0 else n
+        logits = None
+        off = 0
+        c = n
+        while off < n:
+            if (
+                stream.deadline is not None
+                and time.monotonic() >= stream.deadline
+            ):
+                # the chunk boundaries are the prefill's deadline points
+                # (PR 3 enforced pre-prefill and between decode chunks
+                # only): an expired request must not keep dispatching its
+                # remaining prompt against co-batched rows' decode
+                raise faults.DeadlineExceeded(
+                    f"deadline expired mid-prefill (row {stream.row}, "
+                    f"{off}/{n} prompt tokens dispatched)"
+                )
+            c = min(step, n - off)
+            bucket = _prefill_bucket(c)
+            if stream.pos + bucket > engine.cfg.seq_len:
+                bucket = c  # exact-length compile near the context limit
+            padded = np.zeros(bucket, dtype=np.int32)
+            padded[:c] = tokens[off : off + c]
+            with self._cond:
+                if engine._tp_engine is None:
+                    logits, self._slab = _slab_prefill_single(
+                        engine.cfg, engine.params, jnp.asarray(padded), self._slab,
+                        jnp.int32(stream.row), jnp.int32(stream.pos), jnp.int32(c),
+                    )
+                else:
+                    logits, self._slab = engine._tp_engine.slab_forward(
+                        engine.params, jnp.asarray(padded), self._slab,
+                        stream.row, stream.pos, c,
+                    )
+                stream.pos += c
+            off += c
+        return logits, c - 1
+
+    # ------------------------------------------------------------------
+    # Prefix cache (ISSUE 4): admission-time match/gather + publish.
+    # Tree state, slab and pool all mutate under the cond lock; the device
+    # programs themselves are async dispatches whose ordering the device
+    # stream guarantees (a gather dispatched before a publish reads the
+    # pool version it was built against).
+    # ------------------------------------------------------------------
+
+    def _gather_matched(self, stream: BatchStream, tokens: np.ndarray) -> list:
+        """Walk the radix tree for the prompt's longest published prefix
+        and bind the matched pages to the row (copy into the slab). Returns
+        the matched (ref-held) chain; the row's position advances past the
+        matched tokens, so only the suffix prefills."""
+        prefix = self._prefix
+        page = prefix.page
+        engine = self.engine
         with self._cond:
-            if engine._tp_engine is None:
-                logits, self._slab = _slab_prefill_single(
-                    engine.cfg, engine.params, jnp.asarray(padded), self._slab,
-                    jnp.int32(stream.row), jnp.int32(stream.pos), jnp.int32(n),
-                )
-            else:
-                logits, self._slab = engine._tp_engine.slab_forward(
-                    engine.params, jnp.asarray(padded), self._slab,
-                    stream.row, stream.pos, n,
-                )
-            stream.pos += n
-        return logits
+            chain = prefix.match(tokens)
+            if not chain:
+                return []
+            n_pages = len(chain)
+            bucket = _page_bucket(n_pages)
+            # pad sentinel: CEIL(S/page), so every padded slot lands at or
+            # beyond S and drops — a floor sentinel with S % page != 0
+            # would write page 0's bytes into the row tail
+            s_pages = -(-engine.cfg.seq_len // page)
+            ids = np.zeros(bucket, np.int32)
+            dest = np.full(bucket, s_pages, np.int32)  # padded entries drop
+            ids[:n_pages] = [nd.page_id for nd in chain]
+            dest[:n_pages] = np.arange(n_pages)
+            with engine._tel.span(
+                "prefix_gather", pages=n_pages, batch_row=stream.row
+            ):
+                try:
+                    self._slab = _gather_pages(
+                        page, self._slab, self._pool, jnp.asarray(ids),
+                        jnp.asarray(dest), jnp.int32(stream.row),
+                    )
+                except BaseException:
+                    # a failed gather dispatch must not leave the chain
+                    # ref-pinned against eviction forever; the request
+                    # itself fails (the row's prefix bytes are undefined)
+                    prefix.release(chain)
+                    raise
+            stream.pos = n_pages * page
+        return chain
+
+    def _publish_row(self, stream: BatchStream, tokens: np.ndarray, chain: list) -> None:
+        """Publish the admission prefill's completed full pages back into
+        the tree (blocks beyond the matched chain), then release the
+        chain's admission refs. Publishing copies OUT of the row into
+        fresh pool pages — the tree never aliases live row storage, so a
+        later quarantine/reset of this row cannot free or corrupt tree
+        pages (chaos-enforced, bench.py --prefix-cache --chaos)."""
+        prefix = self._prefix
+        page = prefix.page
+        with self._cond:
+            try:
+                new_ids, new_blocks = prefix.publish(tokens, tokens.shape[0], chain)
+                if new_ids:
+                    bucket = _page_bucket(len(new_ids))
+                    ids = np.full(bucket, prefix.capacity, np.int32)  # pad drops
+                    src = np.zeros(bucket, np.int32)
+                    ids[: len(new_ids)] = new_ids
+                    src[: len(new_ids)] = new_blocks
+                    with self.engine._tel.span(
+                        "prefix_publish", pages=len(new_ids), batch_row=stream.row
+                    ):
+                        try:
+                            self._pool = _publish_pages(
+                                page, self._slab, self._pool, jnp.asarray(ids),
+                                jnp.asarray(src), jnp.int32(stream.row),
+                            )
+                        except BaseException as e:
+                            # the copy never dispatched: the just-inserted
+                            # nodes map blocks to pages holding garbage (or
+                            # a recycled prefix's stale bytes) — detach them
+                            # or every future match serves wrong KV. The
+                            # REQUEST is fine (its prefill completed):
+                            # publishing is an optimization, so swallow
+                            # everything except interpreter exits
+                            prefix.unpublish(tokens, new_ids, new_blocks)
+                            if not isinstance(e, Exception):
+                                raise
+                            print(f"⚠️ prefix publish failed; pages unwound: {e}")
+            finally:
+                prefix.release(chain)
 
     # ------------------------------------------------------------------
     # Join/leave (between chunks; the cond lock makes the active set

@@ -42,13 +42,19 @@ from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 
 
-def _prefill_bucket(n: int) -> int:
-    """Pad prompt lengths to power-of-two buckets so XLA compiles a handful of
-    prefill programs instead of one per prompt length."""
-    b = 8
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (and >= 1): the one bucketing primitive
+    behind the prefill/decode-row/page-id buckets."""
+    b = 1
     while b < n:
         b *= 2
     return b
+
+
+def _prefill_bucket(n: int) -> int:
+    """Pad prompt lengths to power-of-two buckets (floor 8) so XLA compiles
+    a handful of prefill programs instead of one per prompt length."""
+    return max(8, next_pow2(n))
 
 
 @dataclasses.dataclass
@@ -89,6 +95,11 @@ class EngineStream:
         # the surface (the batch scheduler additionally enforces it
         # between chunks — see engine/batch.py)
         self.deadline: float | None = None
+        # prefix-cache opt-out surface parity with BatchStream (ISSUE 4):
+        # the API server sets this per request on whichever stream kind the
+        # slot wears; only the batch scheduler's paged prefix cache consumes
+        # it — an independent EngineStream has no shared page pool to reuse
+        self.prefix_cache_enabled = True
         engine._streams.append(self)
         engine._tel.active_streams.set(len(engine._streams))
 
@@ -136,6 +147,7 @@ class EngineStream:
         self._release_depth()  # an abandoned un-fetched prefill must not pin the depth
         self._pending_prefill_entry = None
         self.deadline = None
+        self.prefix_cache_enabled = True
 
     def rollback(self, pos: int) -> None:
         """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots

@@ -1,0 +1,246 @@
+"""Radix tree over token blocks: prompt-prefix KV reuse across requests.
+
+The serving workload the ROADMAP targets is dominated by shared prefixes —
+the same system prompt and conversation history arrive over and over, and
+the reference engine (like our own pre-page scheduler) re-prefills every
+one of them from position 0. Prefill is the expensive phase (130 ms warm /
+8.6 s cold per 64 tokens vs 9.2 ms/token decode, BENCH_r05), so reusing
+prefill compute across requests is the biggest remaining serving win. This
+is the RadixAttention idea (SGLang, Zheng et al. 2024) over PagedAttention
+pages (vLLM, Kwon et al. 2023), adapted to the TPU-friendly static-shape
+slab of engine/batch.py.
+
+Design
+------
+* The prompt's token stream is split into fixed-size **blocks** of ``page``
+  positions. Each radix-tree node owns exactly one block: its edge key is
+  the block's token tuple (exact-match keys — no hash collisions to
+  reason about) and its payload is one physical page id in the device page
+  pool (:func:`~distributed_llama_tpu.models.llama.init_page_pool`).
+* Pages are **immutable once published**: the scheduler copies a row's
+  completed prefill KV *into* fresh pool pages (publish) and copies
+  matched pages *out* into a new row's slab prefix (admission gather) —
+  correctness-first copy semantics; rows never alias tree pages, so a
+  quarantined or reset row can NEVER free/corrupt pages the tree still
+  references (test- and chaos-enforced). Zero-copy paged attention is the
+  documented follow-up.
+* **Refcounts** pin a matched chain between the host-side match decision
+  and the device gather dispatch (the only window where eviction could
+  hand the page to a concurrent publish). ``refs == 0`` nodes are
+  evictable; eviction is leaf-first LRU (``last_use`` clock), so a chain
+  ages out from its deepest, least-shared end while shared system-prompt
+  roots survive.
+* The pool size (``--kv-pages``) IS the HBM budget: allocation evicts
+  LRU-unreferenced leaves only when the free list runs dry, and fails
+  softly (the scheduler simply skips publishing) when everything is
+  pinned. Eviction is an O(pages-in-tree) host scan per reclaimed page —
+  fine at the default budgets (hundreds of pages, tens of µs under the
+  scheduler lock); a last_use-ordered leaf index is the known follow-up
+  if ``--kv-pages`` grows to the tens of thousands.
+
+Thread model: the owning :class:`~distributed_llama_tpu.engine.batch.
+BatchScheduler` calls every method under its condition lock; the tree
+itself is lock-free on purpose (one lock, one owner — no ordering hazards
+between tree state and slab/pool dispatches).
+"""
+
+from __future__ import annotations
+
+from distributed_llama_tpu import telemetry
+
+
+class PageNode:
+    """One radix-tree node: a ``page``-token block bound to one pool page."""
+
+    __slots__ = ("key", "page_id", "parent", "children", "refs", "last_use")
+
+    def __init__(self, key, page_id: int, parent: "PageNode | None"):
+        self.key = key  # tuple of the block's token ids (edge label)
+        self.page_id = page_id
+        self.parent = parent
+        self.children: dict[tuple, PageNode] = {}
+        self.refs = 0
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Host-side index of the device page pool (see module docstring)."""
+
+    def __init__(self, n_pages: int, page: int):
+        if n_pages < 1:
+            raise ValueError(f"need at least one pool page, got {n_pages}")
+        if page < 1:
+            raise ValueError(f"page size must be positive, got {page}")
+        self.page = page
+        self.capacity = n_pages
+        self.free: list[int] = list(range(n_pages))
+        self.root = PageNode(None, -1, None)
+        self._clock = 0
+        self.tel = telemetry.PrefixCacheInstruments()
+        self.tel.pages.set(0)
+
+    # ------------------------------------------------------------------
+    # Introspection (tests + metrics)
+    # ------------------------------------------------------------------
+
+    def pages_in_use(self) -> int:
+        return self.capacity - len(self.free)
+
+    def _walk(self):
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def check(self) -> None:
+        """Structural invariants (tests + the eviction stress): every tree
+        page is allocated exactly once and disjoint from the free list."""
+        seen: set[int] = set()
+        for node in self._walk():
+            assert 0 <= node.page_id < self.capacity, node.page_id
+            assert node.page_id not in seen, f"page {node.page_id} aliased"
+            assert node.refs >= 0, f"negative refcount on page {node.page_id}"
+            seen.add(node.page_id)
+        free = set(self.free)
+        assert not (seen & free), f"tree/free overlap: {sorted(seen & free)}"
+        assert len(seen) + len(free) == self.capacity, (
+            f"page leak: {len(seen)} in tree + {len(free)} free "
+            f"!= {self.capacity}"
+        )
+
+    # ------------------------------------------------------------------
+    # Match / release (admission)
+    # ------------------------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens) -> list[PageNode]:
+        """Longest chain of full-block matches STRICTLY shorter than the
+        prompt (at least the last token always prefills — its logits seed
+        the first sampled token). Acquires one ref per matched node; the
+        caller must :meth:`release` the returned chain once the gathered
+        pages have been dispatched."""
+        page = self.page
+        max_blocks = (len(tokens) - 1) // page
+        chain: list[PageNode] = []
+        node = self.root
+        for i in range(max_blocks):
+            child = node.children.get(tuple(tokens[i * page : (i + 1) * page]))
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        t = self._tick()
+        for nd in chain:
+            nd.refs += 1
+            nd.last_use = t
+        if chain:
+            self.tel.hits.inc()
+            self.tel.matched_tokens.observe(len(chain) * page)
+        else:
+            self.tel.misses.inc()
+        return chain
+
+    def release(self, chain: list[PageNode]) -> None:
+        for nd in chain:
+            nd.refs -= 1
+
+    # ------------------------------------------------------------------
+    # Publish (after a completed admission prefill)
+    # ------------------------------------------------------------------
+
+    def publish(
+        self, tokens, n_total: int, parent_chain: list[PageNode]
+    ) -> tuple[list[int], list[int]]:
+        """Insert the full blocks of ``tokens[:n_total]`` beyond
+        ``parent_chain`` into the tree. Returns ``(page_ids, block_idx)``
+        of the NEWLY allocated pages — the scheduler copies those blocks
+        out of the row; blocks already present (a concurrent request
+        published them first) are refreshed, not re-copied. Allocation
+        evicts LRU-unreferenced leaves when the free list is dry and stops
+        early (partial publish) when nothing is evictable."""
+        node = parent_chain[-1] if parent_chain else self.root
+        page = self.page
+        new_ids: list[int] = []
+        new_blocks: list[int] = []
+        t = self._tick()
+        # pin the whole growing chain for the duration of the walk: a
+        # mid-publish _alloc may evict, and an unpinned just-inserted (or
+        # traversed) node is a refcount-0 leaf — the evictor would detach
+        # the very chain being built, double-allocating its page and
+        # leaking the rest (reproduced: capacity-1 pool, 2-block publish)
+        pinned: list[PageNode] = list(parent_chain)
+        for nd in pinned:
+            nd.refs += 1
+        try:
+            for i in range(len(parent_chain), n_total // page):
+                key = tuple(tokens[i * page : (i + 1) * page])
+                child = node.children.get(key)
+                if child is None:
+                    pid = self._alloc()
+                    if pid is None:
+                        break  # budget exhausted and everything pinned
+                    child = PageNode(key, pid, node)
+                    node.children[key] = child
+                    new_ids.append(pid)
+                    new_blocks.append(i)
+                child.refs += 1
+                pinned.append(child)
+                child.last_use = t
+                node = child
+        finally:
+            for nd in pinned:
+                nd.refs -= 1
+        self.tel.pages.set(self.pages_in_use())
+        return new_ids, new_blocks
+
+    def unpublish(self, tokens, new_ids: list[int], new_blocks: list[int]) -> None:
+        """Unwind a :meth:`publish` whose device copy failed to dispatch:
+        detach the inserted sub-chain and return its pages to the free
+        list. The pages were never written — leaving them mapped would
+        serve garbage (or a recycled prefix's stale) KV to every future
+        match. ``new_blocks`` is a contiguous tail by construction (once
+        publish creates a node, every deeper block is new too), so
+        detaching the FIRST new node drops the whole sub-chain."""
+        if not new_ids:
+            return
+        page = self.page
+        node = self.root
+        for i in range(new_blocks[0]):
+            node = node.children[tuple(tokens[i * page : (i + 1) * page])]
+        first = new_blocks[0]
+        del node.children[tuple(tokens[first * page : (first + 1) * page])]
+        self.free.extend(new_ids)
+        self.tel.pages.set(self.pages_in_use())
+
+    # ------------------------------------------------------------------
+    # Allocation / LRU eviction
+    # ------------------------------------------------------------------
+
+    def _alloc(self) -> int | None:
+        if self.free:
+            return self.free.pop()
+        if self._evict_one():
+            return self.free.pop()
+        return None
+
+    def _evict_one(self) -> bool:
+        """Reclaim the least-recently-used unreferenced LEAF (children keep
+        their ancestors alive: evicting an interior page would strand the
+        chain below it). Returns False when every leaf is pinned."""
+        victim: PageNode | None = None
+        for node in self._walk():
+            if node.children or node.refs > 0:
+                continue
+            if victim is None or node.last_use < victim.last_use:
+                victim = node
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        self.free.append(victim.page_id)
+        self.tel.evictions.inc()
+        self.tel.pages.set(self.pages_in_use())
+        return True

@@ -456,6 +456,30 @@ def init_batch_cache(
     ]
 
 
+def init_page_pool(
+    cfg: LlamaConfig,
+    n_pages: int,
+    page: int,
+    n_kv_heads_local: int | None = None,
+    dtype=jnp.float32,
+) -> list[tuple[jax.Array, jax.Array]]:
+    """Prefix-cache page pool: a list of per-layer ``(keys, values)`` halves
+    of [n_pages, page, Kl, hd] (engine.prefix_cache). Pages hold immutable,
+    refcounted KV prefixes published from slab rows; its HBM budget is
+    n_pages * page * Kl * hd * 2 dtype-bytes per layer — configured with
+    ``--kv-pages`` on the serving surface."""
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
+    kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
+    return [
+        (
+            kvc.init_page_pool_half(n_pages, page, kl, cfg.head_size, dtype),
+            kvc.init_page_pool_half(n_pages, page, kl, cfg.head_size, dtype),
+        )
+        for _ in range(cfg.n_layers)
+    ]
+
+
 def init_cache(
     cfg: LlamaConfig,
     n_kv_heads_local: int | None = None,

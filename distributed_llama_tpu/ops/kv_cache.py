@@ -212,6 +212,75 @@ def slab_put_row(half, row_half, row):
     return jax.lax.dynamic_update_slice(half, row_half[None], (row, 0, 0, 0))
 
 
+# ---------------------------------------------------------------------------
+# Page pool (engine.prefix_cache): immutable prefix KV pages shared across
+# requests. A pool half is [P, page, K, hd] — the same dtype/pytree rules as
+# the slab (i8 pools carry [P, page, K, 1] scales), so published pages hold
+# the EXACT cache bytes of the row they came from and a gather restores them
+# bit-identically (the prefix-hit == cold-prefill parity contract).
+# ---------------------------------------------------------------------------
+
+
+def init_page_pool_half(n_pages: int, page: int, kl: int, hd: int, dtype):
+    """One pool half of ``n_pages`` fixed-size pages: [P, page, K, hd] (or a
+    QuantizedKV of int8 data + [P, page, K, 1] scales for the i8 sentinel)."""
+    return init_half((n_pages, page, kl, hd), dtype)
+
+
+def gather_pages_to_row(slab_half, pool_half, page_ids, dest_page, row, page: int):
+    """Copy pool pages ``page_ids[i]`` into slab row ``row`` at page slots
+    ``dest_page[i]`` (positions dest_page[i]*page .. +page-1). Both index
+    arrays are traced (one compiled program per padded page-count bucket);
+    the drop is PER SLOT (slot >= S), so the inert pad sentinel is
+    ``ceil(S/page)`` — a floor sentinel would land partially in bounds when
+    page does not divide S and clobber the row tail. Returns the updated
+    slab half (callers donate the slab)."""
+    p_idx = jnp.arange(page)
+    if isinstance(slab_half, QuantizedKV):
+        slots = (dest_page[:, None] * page + p_idx[None, :]).reshape(-1)
+        vals = pool_half.data[page_ids]  # [Np, page, K, hd]
+        scal = pool_half.scales[page_ids]
+        return QuantizedKV(
+            slab_half.data.at[row, slots].set(
+                vals.reshape((-1,) + vals.shape[2:]), mode="drop"
+            ),
+            slab_half.scales.at[row, slots].set(
+                scal.reshape((-1,) + scal.shape[2:]), mode="drop"
+            ),
+        )
+    slots = (dest_page[:, None] * page + p_idx[None, :]).reshape(-1)
+    vals = pool_half[page_ids]
+    return slab_half.at[row, slots].set(
+        vals.reshape((-1,) + vals.shape[2:]), mode="drop"
+    )
+
+
+def publish_row_pages(pool_half, slab_half, row, src_page, page_ids, page: int):
+    """Copy slab row ``row``'s page slots ``src_page[i]`` into pool pages
+    ``page_ids[i]`` (the prefix-cache publish: the row's completed prefill
+    KV becomes an immutable shared page). A ``page_ids`` entry at or beyond
+    P DROPS its write, so padded entries are inert. Returns the updated pool
+    half (callers donate the pool)."""
+    p_idx = jnp.arange(page)
+    slots = (src_page[:, None] * page + p_idx[None, :]).reshape(-1)
+    n = src_page.shape[0]
+    if isinstance(pool_half, QuantizedKV):
+        vals = slab_half.data[row, slots]  # [Np*page, K, hd]
+        scal = slab_half.scales[row, slots]
+        return QuantizedKV(
+            pool_half.data.at[page_ids].set(
+                vals.reshape((n, page) + vals.shape[1:]), mode="drop"
+            ),
+            pool_half.scales.at[page_ids].set(
+                scal.reshape((n, page) + scal.shape[1:]), mode="drop"
+            ),
+        )
+    vals = slab_half[row, slots]
+    return pool_half.at[page_ids].set(
+        vals.reshape((n, page) + vals.shape[1:]), mode="drop"
+    )
+
+
 def compute_dtype(half):
     """The einsum operand dtype for a cache half: the storage dtype for
     plain caches (bf16 reads stay bf16, f32 parity stays f32); bf16 for i8

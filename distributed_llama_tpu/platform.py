@@ -22,17 +22,50 @@ def reassert_jax_platforms() -> None:
         jax.config.update("jax_platforms", env)
 
 
+_cache_hit_listener_installed = False
+
+
+def _install_cache_hit_listener() -> None:
+    """Count persistent-cache hits into telemetry: jax announces each
+    cache-served compile via a monitoring event; the listener forwards it
+    to ``dllama_compile_cache_hits_total`` (no-op while telemetry is off).
+    Best-effort — the monitoring module is a private jax API, so a missing
+    symbol just loses the counter, never the cache."""
+    global _cache_hit_listener_installed
+    if _cache_hit_listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                from distributed_llama_tpu import telemetry
+
+                telemetry.note_compile_cache_hit()
+
+        monitoring.register_event_listener(_on_event)
+        _cache_hit_listener_installed = True
+    except Exception:
+        pass
+
+
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Point XLA's persistent compilation cache at a directory so a fresh
     process reuses compiled programs instead of re-compiling the model
-    (measured 22.5 s for a cold 32-layer Q40 7B prefill program, BENCH_r03).
+    (measured 22.5 s for a cold 32-layer Q40 7B prefill program, BENCH_r03;
+    the 8.6 s cold-prefill number of BENCH_r05 is this compile).
 
     Called by every entry point (CLI, API server, bench) before the first
-    jit. Resolution order: explicit argument, ``DLT_COMPILE_CACHE`` env var
+    jit. Resolution order: explicit argument (the ``--compile-cache-dir``
+    flag), ``DLLAMA_COMPILE_CACHE`` env var, legacy ``DLT_COMPILE_CACHE``
     (empty string disables), else ``~/.cache/distributed_llama_tpu/xla``.
-    Returns the directory in use, or None when disabled or unavailable."""
+    Returns the directory in use, or None when disabled or unavailable.
+    Cache-served compiles are counted in ``dllama_compile_cache_hits_total``
+    when telemetry is enabled."""
     if cache_dir is None:
-        cache_dir = os.environ.get("DLT_COMPILE_CACHE")
+        cache_dir = os.environ.get(
+            "DLLAMA_COMPILE_CACHE", os.environ.get("DLT_COMPILE_CACHE")
+        )
         if cache_dir == "":
             return None
     if cache_dir is None:
@@ -48,6 +81,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # RECOMPILES of big ones; cache everything that took >1s to build
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _install_cache_hit_listener()
         return cache_dir
     except Exception:
         return None  # cache is an optimization; never block startup on it

@@ -182,6 +182,17 @@ class ApiState:
                 self.batch = BatchScheduler(
                     engine, n_rows=n, chunk=getattr(args, "decode_chunk", 32),
                     stall_timeout_s=getattr(args, "stall_timeout_s", None),
+                    # paged prefix cache (ISSUE 4): repeated prompt prefixes
+                    # (system prompts, replayed conversations) skip their
+                    # matched prefill; per-request `cache: off` opts out
+                    prefix_cache=getattr(args, "prefix_cache", True),
+                    kv_pages=getattr(args, "kv_pages", None),
+                    # no falsy-or: an explicit --kv-page-size 0 must reach
+                    # the scheduler's misconfiguration diagnostic, not be
+                    # silently rewritten to the default (the PR 3
+                    # admission_queue=0 bug class)
+                    page_size=getattr(args, "kv_page_size", 64),
+                    prefill_chunk=getattr(args, "prefill_chunk", 256),
                 )
             except ValueError as e:  # backend without a batched path (sp/ep)
                 print(f"⚠️ batch decode disabled: {e}")
@@ -318,9 +329,13 @@ class ApiState:
         slot = self._acquire_slot(params["messages"], deadline)
         try:
             slot.stream.deadline = deadline
+            # per-request prefix-cache opt-out (`cache: off` in the body):
+            # the row neither matches nor publishes shared KV pages
+            slot.stream.prefix_cache_enabled = params.get("cache", "on") != "off"
             return self._complete_on(slot, params, send_chunk, request_id, deadline)
         finally:
             slot.stream.deadline = None
+            slot.stream.prefix_cache_enabled = True
             self._release_slot(slot)
 
     def _complete_on(
@@ -569,7 +584,11 @@ class ApiState:
             # NaN must not pass: it poisons every monotonic comparison AND
             # Semaphore.acquire(timeout=nan) blocks forever
             raise BadRequest("'deadline_ms' must be a positive finite number of ms")
+        cache = body.get("cache", "on")
+        if cache not in ("on", "off"):
+            raise BadRequest("'cache' must be \"on\" or \"off\"")
         return {
+            "cache": cache,
             "messages": [
                 {"role": m["role"], "content": m["content"]} for m in messages
             ],
@@ -861,11 +880,16 @@ def install_sigterm_drain(state: ApiState, server, timeout_s: float = 30.0):
 
 def serve(args) -> None:
     from distributed_llama_tpu.apps.cli import make_engine
+    from distributed_llama_tpu.platform import enable_compilation_cache
 
     # --telemetry / DLLAMA_TELEMETRY must take effect BEFORE the engine and
     # ApiState bind their instrument bundles (bind-once contract)
     if getattr(args, "telemetry", False):
         telemetry.enable()
+    # the persistent compile cache must be configured before make_engine's
+    # first jit (--compile-cache-dir / DLLAMA_COMPILE_CACHE; the 8.6 s
+    # cold-prefill compile of BENCH_r05 becomes a cache deserialization)
+    enable_compilation_cache(getattr(args, "compile_cache_dir", None))
     # --faults installs the chaos plan BEFORE the engine/scheduler bind
     # their hooks (same bind-once contract; docs/ROBUSTNESS.md)
     spec = getattr(args, "faults", None)
@@ -891,13 +915,11 @@ def serve(args) -> None:
 
 def main(argv=None) -> None:
     from distributed_llama_tpu.apps.cli import build_parser
-    from distributed_llama_tpu.platform import (
-        enable_compilation_cache,
-        reassert_jax_platforms,
-    )
+    from distributed_llama_tpu.platform import reassert_jax_platforms
 
     reassert_jax_platforms()
-    enable_compilation_cache()
+    # the compile cache is configured by serve() AFTER parsing, so the
+    # --compile-cache-dir flag can point it somewhere else
     parser = build_parser()
     parser.add_argument("--port", type=int, default=9990)
     parser.add_argument(
@@ -912,6 +934,35 @@ def main(argv=None) -> None:
         "requests — near-Bx aggregate tok/s on the HBM-bound decode; "
         "single-chip and --tp backends, --decode device). "
         "--no-batch-decode restores independent per-request dispatches",
+    )
+    # paged prefix cache (ISSUE 4, docs/PERF.md)
+    parser.add_argument(
+        "--prefix-cache", action=argparse.BooleanOptionalAction, default=True,
+        help="reuse published KV pages for repeated prompt prefixes "
+        "(radix tree over token blocks; admission prefills only the "
+        "unmatched suffix — the chat system-prompt workload's TTFT win). "
+        "Requests opt out per call with body field 'cache': \"off\". "
+        "Batched serving on the single-chip backend only",
+    )
+    parser.add_argument(
+        "--kv-pages", type=int, default=None,
+        help="page-pool HBM budget in pages for --prefix-cache (default "
+        "--parallel x seq_len/page pages — roughly ONE extra KV slab of "
+        "HBM; size explicitly on deployments near the memory limit, 0 "
+        "disables the prefix cache); the LRU evictor reclaims "
+        "unreferenced chains beyond it",
+    )
+    parser.add_argument(
+        "--kv-page-size", type=int, default=64,
+        help="positions per KV page (prefix-match granularity; smaller "
+        "pages match finer but cost more host bookkeeping)",
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=256,
+        help="tokens per prefill dispatch in batched serving: long prompts "
+        "chunk so co-batched rows' decode interleaves between the chunks "
+        "(Sarathi-style) instead of stalling behind the whole prompt "
+        "(0 = monolithic prompt dispatch)",
     )
     # fault tolerance (docs/ROBUSTNESS.md)
     parser.add_argument(

@@ -268,6 +268,59 @@ class EngineInstruments:
         )
 
 
+class PrefixCacheInstruments:
+    """The radix prefix cache's metric surface (bound once per PrefixCache;
+    engine/prefix_cache.py + docs/PERF.md)."""
+
+    # matched-prefix length is a token count, not a latency: power-of-two
+    # buckets up to a 16k context
+    MATCHED_TOKEN_BUCKETS = (
+        1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+        1024.0, 2048.0, 4096.0, 8192.0, 16384.0,
+    )
+
+    def __init__(self):
+        self.enabled = _enabled
+        self.hits = counter(
+            "dllama_prefix_cache_hits_total",
+            "Admission prefills that reused at least one published KV page "
+            "(the matched prefix skipped recomputation)",
+        )
+        self.misses = counter(
+            "dllama_prefix_cache_misses_total",
+            "Admission prefills that matched no published prefix page",
+        )
+        self.evictions = counter(
+            "dllama_prefix_cache_evictions_total",
+            "KV pages reclaimed from the radix tree by the LRU evictor "
+            "(leaf-first; refcounted pages are never evicted)",
+        )
+        self.pages = gauge(
+            "dllama_prefix_cache_pages",
+            "KV pages currently held by the radix tree (the pool size "
+            "--kv-pages bounds this; free = pool - this)",
+        )
+        self.matched_tokens = histogram(
+            "dllama_prefix_cache_matched_tokens",
+            "Prompt tokens satisfied from the prefix cache per hit "
+            "(page-granular)",
+            buckets=self.MATCHED_TOKEN_BUCKETS,
+        )
+
+
+def note_compile_cache_hit() -> None:
+    """Count one persistent-compilation-cache hit (a compile served from
+    ``--compile-cache-dir`` instead of a fresh XLA build — the 8.6 s
+    cold-prefill attack, BENCH_r05). Called from the jax monitoring
+    listener platform.enable_compilation_cache installs; cache events are
+    rare, so the registry lookup per event is fine (no bind-once needed)."""
+    if _enabled:
+        REGISTRY.counter(
+            "dllama_compile_cache_hits_total",
+            "jit compiles served from the persistent XLA compilation cache",
+        ).inc()
+
+
 class CollectiveInstruments:
     """The parallel backends' transfer-probe surface (TransferProbeMixin)."""
 

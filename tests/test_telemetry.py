@@ -440,3 +440,30 @@ class TestRegistryIsolation:
         r1, r2 = MetricsRegistry(), MetricsRegistry()
         r1.counter("only_in_r1").inc()
         assert r2.get("only_in_r1") is None
+
+
+class TestCompileCacheCounter:
+    def test_cache_hit_event_increments_counter(self, enabled, tmp_path):
+        """The persistent-compilation-cache listener (platform.
+        enable_compilation_cache) forwards jax's cache-hit monitoring event
+        into dllama_compile_cache_hits_total (ISSUE 4 satellite)."""
+        from distributed_llama_tpu import platform as plat
+
+        assert plat.enable_compilation_cache(str(tmp_path / "xla")) is not None
+        from jax._src import monitoring
+
+        monitoring.record_event("/jax/compilation_cache/cache_hits")
+        got = telemetry.REGISTRY.counter("dllama_compile_cache_hits_total").value
+        assert got == 1
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        assert (
+            telemetry.REGISTRY.counter("dllama_compile_cache_hits_total").value
+            == 1
+        )
+
+    def test_counter_is_noop_when_disabled(self, disabled, tmp_path):
+        from distributed_llama_tpu import platform as plat
+
+        plat.enable_compilation_cache(str(tmp_path / "xla"))
+        telemetry.note_compile_cache_hit()
+        assert telemetry.REGISTRY.get("dllama_compile_cache_hits_total") is None
