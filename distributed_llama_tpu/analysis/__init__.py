@@ -1,0 +1,35 @@
+"""`dllama-analyze` — project-specific static analysis (ISSUE 5).
+
+An AST rule engine that machine-checks the invariants this codebase has
+actually shipped bugs against: use-after-donation of jitted buffers
+(DON-001), scheduler-lock discipline (LCK-001/LCK-002), swallowed
+``BaseException`` in recovery paths (EXC-001), wall-clock misuse
+(CLK-001), and registry consistency for metric names (TEL-001) and fault
+injection sites (FLT-001).
+
+Run it as a module — this is the CI gate::
+
+    python -m distributed_llama_tpu.analysis distributed_llama_tpu/
+
+Inline suppression: ``# dllama: noqa[RULE-ID]`` on the flagged line (with
+a comment stating the invariant that makes the site safe). Grandfathered
+findings live in the committed baseline file (``analysis-baseline.txt``,
+shipped empty). Configuration: ``[tool.dllama.analysis]`` in
+pyproject.toml. Catalogue, history and workflow: docs/ANALYSIS.md.
+
+The package imports only the standard library (no jax/numpy), so the gate
+runs anywhere the repo checks out.
+"""
+
+from .config import AnalysisConfig, load_config
+from .engine import Finding, analyze
+from .rules import all_rules, rule_ids
+
+__all__ = [
+    "AnalysisConfig",
+    "Finding",
+    "all_rules",
+    "analyze",
+    "load_config",
+    "rule_ids",
+]

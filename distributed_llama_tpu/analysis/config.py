@@ -1,0 +1,152 @@
+"""Configuration for the `dllama-analyze` rule engine (ISSUE 5).
+
+Configuration is committed, not flag-soup: the `[tool.dllama.analysis]`
+section of pyproject.toml holds the baseline path, the registry/doc
+locations the consistency rules (TEL-001 / FLT-001) cross-check, and the
+allowlists (CLK-001's wall-clock-appropriate sites, extra lock attributes,
+extra blocking-call names). The CLI discovers the nearest pyproject.toml
+above the first scanned path; tests construct :class:`AnalysisConfig`
+directly.
+
+Python 3.10 has no ``tomllib``, and this repo adds no dependencies, so a
+minimal TOML-subset reader backs the loader up: table headers, ``key =
+"string"`` / ``key = ["a", "b"]`` (arrays may span lines) / booleans /
+integers. The committed section stays inside that subset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+
+@dataclasses.dataclass
+class AnalysisConfig:
+    """Resolved analyzer configuration. Paths are relative to :attr:`root`
+    (the directory holding the pyproject.toml they came from)."""
+
+    root: str = "."
+    # committed fingerprints of grandfathered findings ("" disables)
+    baseline: str = "analysis-baseline.txt"
+    # TEL-001: every metric literal must appear in this document's table
+    observability_doc: str = "docs/OBSERVABILITY.md"
+    # FLT-001: the module whose top-level SITES tuple registers fault sites
+    fault_registry: str = "distributed_llama_tpu/engine/faults.py"
+    # LCK-001/002: attribute names that count as "the scheduler lock"
+    lock_attrs: tuple[str, ...] = ("_cond",)
+    # CLK-001: "relpath" or "relpath::qualname-glob" entries where
+    # time.time() is wall-clock-appropriate (API `created` fields)
+    clock_allow: tuple[str, ...] = ()
+    # LCK-002: extra call names (terminal attribute / function name)
+    # treated as blocking in addition to the built-in set
+    blocking_calls: tuple[str, ...] = ()
+    # fnmatch globs of relpaths to skip entirely
+    exclude: tuple[str, ...] = ()
+    metric_prefix: str = "dllama_"
+
+    def rel_to_root(self, path: str) -> str:
+        return os.path.normpath(os.path.join(self.root, path))
+
+
+_KEYS = {
+    "baseline": str,
+    "observability_doc": str,
+    "fault_registry": str,
+    "lock_attrs": tuple,
+    "clock_allow": tuple,
+    "blocking_calls": tuple,
+    "exclude": tuple,
+    "metric_prefix": str,
+}
+
+
+def _parse_toml_section(text: str, section: str) -> dict:
+    """Extract one table from TOML source without a TOML library: scan to
+    the ``[section]`` header, then read ``key = value`` pairs (strings,
+    string arrays — possibly multi-line — booleans, ints) until the next
+    table header."""
+    lines = text.splitlines()
+    out: dict = {}
+    in_section = False
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("["):
+            in_section = line == f"[{section}]"
+            continue
+        if not in_section or not line or line.startswith("#"):
+            continue
+        m = re.match(r"([A-Za-z0-9_-]+)\s*=\s*(.*)$", line)
+        if not m:
+            continue
+        key, value = m.group(1), m.group(2).strip()
+        if value.startswith("["):
+            # accumulate until the array's brackets balance
+            while value.count("[") > value.count("]") and i < len(lines):
+                value += " " + lines[i].strip()
+                i += 1
+            out[key] = re.findall(r'"((?:[^"\\]|\\.)*)"', value)
+        elif value.startswith('"'):
+            sm = re.match(r'"((?:[^"\\]|\\.)*)"', value)
+            out[key] = sm.group(1) if sm else ""
+        elif value in ("true", "false"):
+            out[key] = value == "true"
+        else:
+            try:
+                out[key] = int(value.split("#")[0].strip())
+            except ValueError:
+                out[key] = value
+        # strip inline comments from bare strings only; quoted forms above
+        # already isolated their payload
+    return out
+
+
+def _read_section(pyproject_path: str) -> dict:
+    with open(pyproject_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        import tomllib  # Python >= 3.11
+
+        data = tomllib.loads(text)
+        return data.get("tool", {}).get("dllama", {}).get("analysis", {})
+    except ModuleNotFoundError:
+        return _parse_toml_section(text, "tool.dllama.analysis")
+
+
+def find_pyproject(start: str) -> str | None:
+    """Walk up from ``start`` (file or directory) to the nearest
+    pyproject.toml containing a ``[tool.dllama.analysis]`` section, falling
+    back to the nearest pyproject.toml at all."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    first_any = None
+    while True:
+        cand = os.path.join(d, "pyproject.toml")
+        if os.path.isfile(cand):
+            if first_any is None:
+                first_any = cand
+            if _read_section(cand):
+                return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return first_any
+        d = parent
+
+
+def load_config(start: str | None = None, pyproject: str | None = None) -> AnalysisConfig:
+    """Build an :class:`AnalysisConfig` from the pyproject.toml nearest to
+    ``start`` (or the explicit ``pyproject`` path). Unknown keys are
+    ignored; missing file/section yields the defaults rooted at ``start``."""
+    path = pyproject or (find_pyproject(start or os.getcwd()))
+    if path is None:
+        return AnalysisConfig(root=os.path.abspath(start or os.getcwd()))
+    section = _read_section(path)
+    kwargs: dict = {"root": os.path.dirname(os.path.abspath(path))}
+    for key, typ in _KEYS.items():
+        if key in section:
+            val = section[key]
+            kwargs[key] = tuple(val) if typ is tuple else typ(val)
+    return AnalysisConfig(**kwargs)

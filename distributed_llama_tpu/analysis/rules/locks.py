@@ -1,0 +1,156 @@
+"""LCK-001 / LCK-002 — lock discipline around ``BatchScheduler._cond``.
+
+History: PR 4's Sarathi-style chunked prefill existed precisely because a
+blocking prefill dispatch loop ran while ``self._cond`` was held, starving
+co-batched decode joins for the whole prompt. The convention the scheduler
+settled on — dispatch under the lock, block outside it, ``_locked``-suffixed
+helpers assume the lock — lives in engine/batch.py's section comments.
+These rules make the convention machine-checked:
+
+* **LCK-001** — a call to a ``*_locked`` function must happen either
+  lexically inside a ``with self._cond:`` (any configured lock attribute)
+  or from a function that is itself ``*_locked``. Crossing a nested
+  ``def``/``lambda`` boundary discards the guarantee (the closure runs
+  later, lock state unknown).
+* **LCK-002** — no blocking operation inside a lock-held region (a
+  ``with self._cond:`` body or a ``*_locked`` function): device syncs
+  (``block_until_ready``, ``jax.device_get``, ``np.asarray`` on device
+  values), ``time.sleep``, the scheduler's blocking ``_fetch``, and
+  socket/HTTP primitives. ``self._cond.wait()`` is exempt — it *releases*
+  the lock while waiting.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileCtx, Finding, ProjectContext, Rule
+
+# terminal call names that block the calling thread; np/jax-qualified
+# entries are checked with their base, bare entries match any base
+_BLOCKING_ATTRS = {"block_until_ready", "_fetch", "urlopen", "getaddrinfo",
+                   "create_connection"}
+_BLOCKING_QUALIFIED = {
+    ("jax", "device_get"),
+    ("np", "asarray"),
+    ("numpy", "asarray"),
+    ("time", "sleep"),
+}
+
+
+def _call_name(func: ast.AST) -> tuple[str | None, str | None]:
+    """(base name or None, terminal name) of a call target."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        base = func.value.id if isinstance(func.value, ast.Name) else None
+        return base, func.attr
+    return None, None
+
+
+def _is_lock_expr(node: ast.AST, lock_attrs: tuple[str, ...]) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in lock_attrs
+    if isinstance(node, ast.Name):
+        return node.id in lock_attrs
+    return False
+
+
+def _lock_state(fc: FileCtx, node: ast.AST, lock_attrs: tuple[str, ...]) -> bool:
+    """True when the lock is known-held at ``node``: a ``with <lock>:``
+    ancestor inside the same function, or an enclosing ``*_locked``
+    function. Walking stops at the first function boundary — only that
+    function's own name can vouch for the lock beyond it."""
+    cur = node
+    for anc in fc.ancestors(cur):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            if any(_is_lock_expr(i.context_expr, lock_attrs) for i in anc.items):
+                return True
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc.name.endswith("_locked")
+        elif isinstance(anc, ast.Lambda):
+            return False
+    return False
+
+
+class LockedCallRule(Rule):
+    """LCK-001: ``*_locked`` helpers reached without the lock."""
+
+    id = "LCK-001"
+    severity = "error"
+    short = "call to a *_locked function without holding the scheduler lock"
+
+    def check(self, project: ProjectContext, fc: FileCtx) -> list[Finding]:
+        lock_attrs = project.config.lock_attrs
+        out: list[Finding] = []
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            _, name = _call_name(node.func)
+            if not name or not name.endswith("_locked"):
+                continue
+            if _lock_state(fc, node, lock_attrs):
+                continue
+            out.append(
+                self.finding(
+                    fc,
+                    node,
+                    f"`{name}` follows the _locked convention (caller must"
+                    f" hold {'/'.join(lock_attrs)}) but no enclosing"
+                    " `with <lock>:` or *_locked function vouches for the"
+                    " lock here",
+                )
+            )
+        return out
+
+
+class BlockingUnderLockRule(Rule):
+    """LCK-002: blocking operations inside a lock-held region."""
+
+    id = "LCK-002"
+    severity = "error"
+    short = "blocking call while holding the scheduler lock"
+
+    def check(self, project: ProjectContext, fc: FileCtx) -> list[Finding]:
+        cfg = project.config
+        lock_attrs = cfg.lock_attrs
+        extra = set(cfg.blocking_calls)
+        out: list[Finding] = []
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, name = _call_name(node.func)
+            if not name:
+                continue
+            blocking = (
+                name in _BLOCKING_ATTRS
+                or name in extra
+                or (base, name) in _BLOCKING_QUALIFIED
+                or (
+                    name == "sleep"
+                    and base is None
+                    and fc.from_imports.get("sleep", ("", ""))[0] == "time"
+                )
+            )
+            if not blocking:
+                continue
+            # cond.wait()/lock.acquire-style calls ON the lock are the
+            # coordination primitives themselves, not foreign blocking work
+            if isinstance(node.func, ast.Attribute) and _is_lock_expr(
+                node.func.value, lock_attrs
+            ):
+                continue
+            if not _lock_state(fc, node, lock_attrs):
+                continue
+            label = f"{base}.{name}" if base else name
+            out.append(
+                self.finding(
+                    fc,
+                    node,
+                    f"blocking call `{label}(...)` while"
+                    f" {'/'.join(lock_attrs)} is held — joins and co-batched"
+                    " decode stall behind it (move it outside the `with`, or"
+                    " justify with a noqa stating why the block is bounded)",
+                )
+            )
+        return out

@@ -161,7 +161,8 @@ def make_engine(args):
         moe_capacity_factor=getattr(args, "moe_capacity", 0.0) or 0.0,
     )
     tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
-    seed = args.seed if args.seed is not None else int(time.time())
+    # wall-clock as entropy for a default sampling seed, never a duration
+    seed = args.seed if args.seed is not None else int(time.time())  # dllama: noqa[CLK-001]
     sampler = Sampler(
         vocab_size=engine.cfg.vocab_size,
         temperature=args.temperature,

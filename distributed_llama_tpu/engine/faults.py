@@ -93,6 +93,22 @@ class StallTimeout(RuntimeError):
 
 KINDS = ("raise", "nan", "delay", "hang", "disconnect")
 
+# The registered injection sites — the single source of truth the static
+# analyzer's FLT-001 rule cross-checks against every fire()/fires() call
+# site in the tree (an unregistered site can't be targeted by --faults
+# specs; a registered-but-never-fired site is dead and gets flagged too).
+# Keep this tuple and the docstring table above in sync when adding hooks.
+SITES = (
+    "batch.dispatch",
+    "batch.fetch",
+    "batch.row",
+    "engine.forward",
+    "engine.decode_dispatch",
+    "engine.fetch",
+    "tp.transfer",
+    "server.send",
+)
+
 # a "hang" sleeps this long unless the rule sets delay_ms — far beyond any
 # stall timeout, short enough that a daemon-threaded test process still exits
 HANG_DEFAULT_MS = 60_000.0

@@ -1,0 +1,21 @@
+"""CLK-001 bad fixture: reconstruction of the PR 1 satellite bug — request
+durations measured with the wall clock (an NTP step mid-request yields
+negative latency)."""
+
+import time
+from time import time as now
+
+
+class Handler:
+    def handle(self):
+        t0 = time.time()  # CLK-001: duration start on the wall clock
+        self._work()
+        return time.time() - t0  # CLK-001
+
+    def handle_aliased(self):
+        t0 = now()  # CLK-001: `from time import time` alias
+        self._work()
+        return now() - t0  # CLK-001
+
+    def _work(self):
+        pass

@@ -1,0 +1,18 @@
+"""CLK-001 good fixture: the fixed forms — monotonic clocks for durations
+and deadlines. (User-facing timestamps use the `clock_allow` config
+allowlist, exercised by the suppression/config tests, not this file.)"""
+
+import time
+
+
+class Handler:
+    def handle(self):
+        t0 = time.perf_counter()
+        self._work()
+        return time.perf_counter() - t0
+
+    def expired(self, deadline):
+        return time.monotonic() >= deadline
+
+    def _work(self):
+        pass

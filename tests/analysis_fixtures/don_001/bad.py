@@ -1,0 +1,41 @@
+"""DON-001 bad fixture: donated buffers read after the donating dispatch.
+
+Mirrors the shape of engine/batch.py's slab/pool donation (PR 4): a
+module-level jitted helper with ``donate_argnums`` and a ``self.X =
+jax.jit(...)`` bound callable, each followed by a read of the donated
+array that the real code heals with ``x = f(x)``.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def gather(page, slab, pool):
+    return slab
+
+
+def _step_impl(params, cache):
+    return params, cache
+
+
+class Scheduler:
+    def __init__(self):
+        self.slab = None
+        self.pool = None
+        self._step = jax.jit(_step_impl, donate_argnums=(1,))
+
+    def admit(self, page):
+        out = gather(page, self.slab, self.pool)  # donates self.slab ...
+        return out, self.slab.sum()  # ... which is deleted here: DON-001
+
+    def run(self, params, cache):
+        logits = self._step(params, cache)  # donates cache ...
+        stale = cache + 1  # ... read after dispatch: DON-001
+        return logits, stale
+
+    def aug(self, params, cache):
+        logits = self._step(params, cache)  # donates cache ...
+        cache += 1  # ... += READS the deleted value, it heals nothing: DON-001
+        return logits, cache

@@ -1,0 +1,48 @@
+"""DON-001 good fixture: every donation is self-healing (``x = f(x)``),
+donated as control flow leaves the scope, or rebound before any read."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def gather(page, slab, pool):
+    return slab
+
+
+def _step_impl(params, cache):
+    return params, cache
+
+
+class Scheduler:
+    def __init__(self):
+        self.slab = None
+        self.pool = None
+        self._step = jax.jit(_step_impl, donate_argnums=(1,))
+
+    def admit(self, page):
+        # the repo's idiom: the donated buffer is rebound by the result in
+        # the same statement, so no stale read can exist
+        self.slab = gather(page, self.slab, self.pool)
+        return self.slab.sum()
+
+    def run(self, params, cache):
+        logits, cache = self._step(params, cache)
+        return logits, cache + 1
+
+    def tail_call(self, params, cache):
+        # donation inside a return: nothing in this scope runs afterwards
+        return self._step(params, cache)
+
+    def chunked(self, page, n):
+        # loop-carried self-heal, the _chunk_fwd shape from context_parallel
+        for _ in range(n):
+            self.slab = gather(page, self.slab, self.pool)
+        return self.slab
+
+    def loop_rebound(self, params, cache, fresh_caches):
+        logits = self._step(params, cache)  # donates cache ...
+        for cache in fresh_caches:  # ... but the for target rebinds it
+            logits = logits + cache
+        return logits

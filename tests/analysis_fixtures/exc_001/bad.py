@@ -1,0 +1,28 @@
+"""EXC-001 bad fixture: reconstruction of the PR 3 review bug — retry
+loops catching ``BaseException``, so a Ctrl-C mid-fetch was retried into a
+row quarantine instead of aborting the process."""
+
+import time
+
+
+class Fetcher:
+    retries = 3
+
+    def fetch_with_retries(self):
+        error = None
+        for attempt in range(self.retries):
+            try:
+                return self._do_fetch()
+            except BaseException as e:  # swallows KeyboardInterrupt: EXC-001
+                error = e
+                time.sleep(0.1 * attempt)
+        raise error
+
+    def best_effort_cleanup(self):
+        try:
+            self._do_fetch()
+        except:  # bare except, nothing re-raised: EXC-001
+            pass
+
+    def _do_fetch(self):
+        return 0

@@ -1,0 +1,45 @@
+"""EXC-001 good fixture: the fixed forms — retries catch ``Exception``
+only; ``BaseException`` handlers exist solely to undo state and re-raise
+(conditionally re-raising counts: an interpreter-exit path exists)."""
+
+import time
+
+
+class Fetcher:
+    retries = 3
+
+    def __init__(self):
+        self.depth = 0
+
+    def fetch_with_retries(self):
+        error = None
+        for attempt in range(self.retries):
+            try:
+                return self._do_fetch()
+            except Exception as e:  # KeyboardInterrupt/SystemExit abort
+                error = e
+                time.sleep(0.1 * attempt)
+        raise error
+
+    def fetch_accounted(self):
+        self.depth += 1
+        try:
+            return self._do_fetch()
+        except BaseException:
+            self.depth -= 1  # cleanup-and-reraise: the sanctioned shape
+            raise
+
+    def publish(self):
+        try:
+            return self._do_fetch()
+        except BaseException as e:
+            self._unwind()
+            if not isinstance(e, Exception):  # conditional re-raise: ok
+                raise
+            return None
+
+    def _do_fetch(self):
+        return 0
+
+    def _unwind(self):
+        pass

@@ -1,0 +1,8 @@
+"""FLT-001 bad fixture: a fire() on an unregistered site (a --faults spec
+could never target it), plus — because registry.py is scanned alongside —
+registered sites nothing fires (dead entries)."""
+
+
+def hot_path(plan):
+    plan.fire("site.unknown")  # FLT-001: not in SITES
+    plan.fire("site.known")

@@ -1,0 +1,8 @@
+"""FLT-001 good fixture: every fired site is registered and every
+registered site is fired."""
+
+
+def hot_path(plan, row):
+    plan.fire("site.known")
+    plan.fire("site.other", row=row)
+    return plan.fires("site.dead", rows=(row,))

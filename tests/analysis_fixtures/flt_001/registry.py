@@ -1,0 +1,7 @@
+"""FLT-001 fixture registry (stands in for engine/faults.py)."""
+
+SITES = (
+    "site.known",
+    "site.other",
+    "site.dead",
+)

@@ -1,0 +1,24 @@
+"""LCK-001 bad fixture: a ``*_locked`` helper reached without the lock."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = None
+
+    def _dispatch_locked(self):
+        self._pending = object()
+
+    def kick(self):
+        self._dispatch_locked()  # no `with self._cond:` here: LCK-001
+
+    def pump(self):
+        with self._cond:
+            pass
+        self._dispatch_locked()  # lock already released: LCK-001
+
+    def deferred(self):
+        with self._cond:
+            return lambda: self._dispatch_locked()  # runs later: LCK-001

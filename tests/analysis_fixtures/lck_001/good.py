@@ -1,0 +1,25 @@
+"""LCK-001 good fixture: ``*_locked`` helpers reached under the lock or
+from other ``*_locked`` helpers."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = None
+
+    def _dispatch_locked(self):
+        self._pending = object()
+
+    def _pump_locked(self):
+        self._dispatch_locked()  # caller is itself *_locked: fine
+
+    def kick(self):
+        with self._cond:
+            self._dispatch_locked()
+
+    def drain(self):
+        with self._cond:
+            if self._pending is None:
+                self._pump_locked()

@@ -1,0 +1,27 @@
+"""LCK-002 bad fixture: blocking work while the scheduler lock is held —
+the exact shape of the pre-Sarathi prefill bug (PR 4): device syncs and
+sleeps inside ``with self._cond:`` starve every co-batched join."""
+
+import threading
+import time
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.dev = None
+
+    def pump(self):
+        with self._cond:
+            time.sleep(0.01)  # LCK-002: sleep under the lock
+            toks = np.asarray(self.dev)  # LCK-002: blocking device fetch
+            self.dev.block_until_ready()  # LCK-002: device sync
+            return toks
+
+    def _dispatch_locked(self):
+        self._fetch()  # LCK-002: the blocking fetch inside a *_locked fn
+
+    def _fetch(self):
+        return np.asarray(self.dev)

@@ -1,0 +1,36 @@
+"""LCK-002 good fixture: the repo's actual discipline — dispatch under the
+lock, block outside it; ``cond.wait`` (which releases the lock) is fine."""
+
+import threading
+import time
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.dev = None
+        self._pending = None
+        self._shutdown = False
+
+    def watchdog(self):
+        while not self._shutdown:
+            time.sleep(0.05)  # sleeps, THEN takes the lock (batch.py shape)
+            with self._cond:
+                if self._pending is None:
+                    continue
+
+    def next_token(self):
+        pend = None
+        with self._cond:
+            if self._pending is not None:
+                pend = self._pending
+                self._pending = None
+            else:
+                self._cond.wait(timeout=0.1)  # releases the lock: exempt
+        if pend is not None:
+            return self._fetch()  # blocking fetch OUTSIDE the lock
+
+    def _fetch(self):
+        return np.asarray(self.dev)

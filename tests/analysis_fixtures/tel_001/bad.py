@@ -1,0 +1,16 @@
+"""TEL-001 bad fixture: metric literals that are malformed or missing
+from the observability doc's table."""
+
+from distributed_llama_tpu import telemetry
+
+DRIFTED = telemetry.counter(
+    "dllama_undocumented_total", "registered but absent from the doc table"
+)  # TEL-001: undocumented
+
+BAD_CASE = telemetry.gauge(
+    "dllama_BadCase", "uppercase breaks the prometheus namespace"
+)  # TEL-001: malformed name
+
+NO_PREFIX = telemetry.counter(
+    "batch_retries_total", "forgot the dllama_ namespace"
+)  # TEL-001: missing prefix

@@ -1,0 +1,15 @@
+"""TEL-001 good fixture: well-formed, documented metric literals; dynamic
+names and non-metric strings are out of scope."""
+
+from distributed_llama_tpu import telemetry
+
+DOCUMENTED = telemetry.counter("dllama_documented_total", "in the table")
+LATENCY = telemetry.histogram("dllama_documented_seconds", "in the table")
+
+
+def passthrough(name: str):
+    # non-literal names are the registry wrappers' own business
+    return telemetry.counter(name, "dynamic")
+
+
+MODEL_URL = "https://example.com/dllama_model_fixture.m"  # not a metric call

@@ -1,0 +1,345 @@
+"""Tests for the `dllama-analyze` rule engine (ISSUE 5).
+
+Every rule gets a discriminating bad/good fixture pair under
+``tests/analysis_fixtures/`` — the bad file reconstructs the invariant
+violation (including the real PR 3 ``except BaseException`` retry bug and
+the real PR 1 ``time.time()`` duration bug), the good file its shipped
+fixed form. The self-check test mirrors the CI gate: the analyzer must
+exit clean on the real package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from distributed_llama_tpu.analysis import (
+    AnalysisConfig,
+    all_rules,
+    analyze,
+    load_config,
+    rule_ids,
+)
+from distributed_llama_tpu.analysis.__main__ import main as cli_main
+from distributed_llama_tpu.analysis.config import _parse_toml_section
+from distributed_llama_tpu.analysis.engine import write_baseline
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "analysis_fixtures")
+PKG = os.path.join(REPO, "distributed_llama_tpu")
+
+
+def fixture(sub: str, *names: str) -> list[str]:
+    return [os.path.join(FIXTURES, sub, n) for n in names]
+
+
+def run_rule(rule_id: str, files: list[str], cfg: AnalysisConfig):
+    findings, stats = analyze(files, cfg, rules=all_rules({rule_id}))
+    return findings, stats
+
+
+def cfg_for(sub: str, **kw) -> AnalysisConfig:
+    return AnalysisConfig(root=os.path.join(FIXTURES, sub), baseline="", **kw)
+
+
+# ---------------------------------------------------------------------------
+# Bad fixture fires / good fixture stays silent, per rule
+# ---------------------------------------------------------------------------
+
+CASES = [
+    # (rule, fixture dir, expected findings on bad.py, extra files, config kwargs)
+    # don_001: the aug() case yields TWO findings — `cache += 1` reads the
+    # deleted buffer AND does not heal it, so the later return-read fires too
+    ("DON-001", "don_001", 4, (), {}),
+    ("LCK-001", "lck_001", 3, (), {}),
+    ("LCK-002", "lck_002", 4, (), {}),
+    ("EXC-001", "exc_001", 2, (), {}),
+    ("CLK-001", "clk_001", 4, (), {}),
+    ("TEL-001", "tel_001", 3, (), {"observability_doc": "doc.md"}),
+    ("FLT-001", "flt_001", 3, ("registry.py",), {"fault_registry": "registry.py"}),
+]
+
+
+@pytest.mark.parametrize("rule,sub,n_bad,extra,kw", CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_on_bad_fixture(rule, sub, n_bad, extra, kw):
+    cfg = cfg_for(sub, **kw)
+    findings, _ = run_rule(rule, fixture(sub, "bad.py", *extra), cfg)
+    assert len(findings) == n_bad, [f.format() for f in findings]
+    assert all(f.rule == rule for f in findings)
+    # findings carry usable locations
+    assert all(f.line > 0 and f.path for f in findings)
+
+
+@pytest.mark.parametrize("rule,sub,n_bad,extra,kw", CASES, ids=[c[0] for c in CASES])
+def test_rule_silent_on_good_fixture(rule, sub, n_bad, extra, kw):
+    cfg = cfg_for(sub, **kw)
+    findings, _ = run_rule(rule, fixture(sub, "good.py", *extra), cfg)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_exc_001_reconstructs_pr3_retry_bug():
+    """The PR 3 review fix: a retry loop catching BaseException retried
+    Ctrl-C into a quarantine. The bad fixture is that exact loop; the good
+    fixture is the shipped `except Exception` + cleanup-reraise forms."""
+    cfg = cfg_for("exc_001")
+    findings, _ = run_rule("EXC-001", fixture("exc_001", "bad.py"), cfg)
+    retry_hits = [f for f in findings if f.qualname.endswith("fetch_with_retries")]
+    assert len(retry_hits) == 1
+    assert "BaseException" in retry_hits[0].message
+
+
+def test_clk_001_reconstructs_pr1_duration_bug():
+    """The PR 1 satellite fix: request durations on the wall clock."""
+    cfg = cfg_for("clk_001")
+    findings, _ = run_rule("CLK-001", fixture("clk_001", "bad.py"), cfg)
+    assert {f.qualname for f in findings} == {
+        "Handler.handle",
+        "Handler.handle_aliased",
+    }
+
+
+def test_don_001_flags_both_donor_shapes():
+    """Module-level partial-jit donors AND self-bound jax.jit donors."""
+    cfg = cfg_for("don_001")
+    findings, _ = run_rule("DON-001", fixture("don_001", "bad.py"), cfg)
+    assert {f.qualname for f in findings} == {
+        "Scheduler.admit", "Scheduler.run", "Scheduler.aug",
+    }
+    assert any("self.slab" in f.message for f in findings)
+    assert any("`cache`" in f.message for f in findings)
+
+
+def test_flt_001_reports_unknown_and_dead_sites():
+    cfg = cfg_for("flt_001", fault_registry="registry.py")
+    findings, _ = run_rule(
+        "FLT-001", fixture("flt_001", "bad.py", "registry.py"), cfg
+    )
+    unknown = [f for f in findings if "site.unknown" in f.message]
+    dead = [f for f in findings if "dead registry entry" in f.message]
+    assert len(unknown) == 1 and unknown[0].path.endswith("bad.py")
+    assert {f.message.split("`")[1] for f in dead} == {"site.other", "site.dead"}
+    assert all(f.path.endswith("registry.py") for f in dead)
+
+
+def test_flt_001_dead_site_check_needs_full_scan():
+    """Scanning the registry alone cannot prove a site dead."""
+    cfg = cfg_for("flt_001", fault_registry="registry.py")
+    findings, _ = run_rule("FLT-001", fixture("flt_001", "registry.py"), cfg)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# The self-check: the shipped tree is clean (mirrors the CI gate)
+# ---------------------------------------------------------------------------
+
+
+def test_real_package_is_clean():
+    cfg = load_config(start=REPO)
+    findings, stats = analyze([PKG], cfg)
+    assert findings == [], [f.format() for f in findings]
+    assert stats["files"] > 40  # the scan actually covered the package
+    # the justified inline suppressions exist and are counted
+    assert stats["suppressed"] >= 2
+
+
+def test_every_rule_has_a_fixture_pair():
+    covered = {c[0] for c in CASES}
+    assert covered == set(rule_ids())
+    for _, sub, _, _, _ in CASES:
+        assert os.path.isfile(os.path.join(FIXTURES, sub, "bad.py"))
+        assert os.path.isfile(os.path.join(FIXTURES, sub, "good.py"))
+
+
+# ---------------------------------------------------------------------------
+# Suppression, baseline, config
+# ---------------------------------------------------------------------------
+
+
+def test_noqa_rule_scoped(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n\n\ndef handler():\n"
+        "    t0 = time.time()  # dllama: noqa[CLK-001]\n"
+        "    return time.time() - t0\n"
+    )
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="")
+    findings, stats = run_rule("CLK-001", [str(f)], cfg)
+    assert len(findings) == 1 and findings[0].line == 6
+    assert stats["suppressed"] == 1
+
+
+def test_noqa_bare_suppresses_all_rules(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n\n\ndef handler():\n"
+        "    return time.time()  # dllama: noqa\n"
+    )
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="")
+    findings, stats = run_rule("CLK-001", [str(f)], cfg)
+    assert findings == [] and stats["suppressed"] == 1
+
+
+def test_noqa_wrong_rule_does_not_suppress(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n\n\ndef handler():\n"
+        "    return time.time()  # dllama: noqa[DON-001]\n"
+    )
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="")
+    findings, _ = run_rule("CLK-001", [str(f)], cfg)
+    assert len(findings) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("import time\n\n\ndef handler():\n    return time.time()\n")
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="bl.txt")
+    findings, _ = run_rule("CLK-001", [str(f)], cfg)
+    assert len(findings) == 1
+
+    write_baseline(str(tmp_path / "bl.txt"), findings)
+    findings2, stats2 = run_rule("CLK-001", [str(f)], cfg)
+    assert findings2 == [] and stats2["baselined"] == 1
+
+    # line drift does not invalidate the fingerprint; a NEW violation does
+    f.write_text(
+        "import time\n\n# shifted\n\ndef handler():\n    return time.time()\n"
+        "\n\ndef fresh():\n    t1 = time.time()\n    return t1\n"
+    )
+    findings3, stats3 = run_rule("CLK-001", [str(f)], cfg)
+    assert stats3["baselined"] == 1
+    assert len(findings3) == 1 and findings3[0].qualname == "fresh"
+
+
+def test_parse_failure_is_a_finding_not_a_crash(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="")
+    findings, _ = analyze([str(f)], cfg)
+    assert len(findings) == 1 and findings[0].rule == "GEN-001"
+
+
+def test_repo_config_loads():
+    cfg = load_config(start=REPO)
+    assert cfg.root == REPO
+    assert cfg.baseline == "analysis-baseline.txt"
+    assert "_cond" in cfg.lock_attrs and "_depth_lock" in cfg.lock_attrs
+    assert cfg.fault_registry == "distributed_llama_tpu/engine/faults.py"
+    assert any("api.py" in entry for entry in cfg.clock_allow)
+
+
+def test_mini_toml_parser_subset():
+    text = textwrap.dedent(
+        """
+        [tool.other]
+        baseline = "wrong.txt"
+
+        [tool.dllama.analysis]
+        baseline = "bl.txt"
+        lock_attrs = ["_cond",
+            "_depth_lock"]
+        metric_prefix = "dllama_"
+
+        [tool.after]
+        baseline = "also-wrong.txt"
+        """
+    )
+    section = _parse_toml_section(text, "tool.dllama.analysis")
+    assert section["baseline"] == "bl.txt"
+    assert section["lock_attrs"] == ["_cond", "_depth_lock"]
+    assert section["metric_prefix"] == "dllama_"
+
+
+def test_fault_registry_matches_shipped_sites():
+    """The faults.SITES registry and the docstring-era site set agree —
+    FLT-001's source of truth names every hook the chaos harness ships."""
+    from distributed_llama_tpu.engine import faults
+
+    assert set(faults.SITES) == {
+        "batch.dispatch", "batch.fetch", "batch.row", "engine.forward",
+        "engine.decode_dispatch", "engine.fetch", "tp.transfer",
+        "server.send",
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_1_on_findings(capsys):
+    rc = cli_main(
+        [os.path.join(FIXTURES, "clk_001", "bad.py"), "--select", "CLK-001"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1 and "CLK-001" in out and out.strip().endswith(")")
+    assert "FAIL:" in out
+
+
+def test_cli_exit_0_on_clean(capsys):
+    rc = cli_main(
+        [os.path.join(FIXTURES, "clk_001", "good.py"), "--select", "CLK-001"]
+    )
+    assert rc == 0 and "OK:" in capsys.readouterr().out
+
+
+def test_cli_exit_0_on_real_package(capsys):
+    """The exact CI gate invocation."""
+    assert cli_main([PKG]) == 0
+
+
+def test_cli_json_format(capsys):
+    rc = cli_main(
+        [
+            os.path.join(FIXTURES, "exc_001", "bad.py"),
+            "--select", "EXC-001", "--format", "json",
+        ]
+    )
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1 and len(data) == 2
+    assert {d["rule"] for d in data} == {"EXC-001"}
+
+
+def test_cli_usage_errors(capsys):
+    assert cli_main(["/no/such/path.py"]) == 2
+    assert cli_main([PKG, "--select", "NOPE-999"]) == 2
+
+
+def test_cli_write_baseline_needs_a_path(tmp_path, capsys):
+    f = tmp_path / "mod.py"
+    f.write_text("x = 1\n")
+    rc = cli_main([str(f), "--baseline", "", "--write-baseline"])
+    assert rc == 2
+    assert "baseline path" in capsys.readouterr().err
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in rule_ids():
+        assert rid in out
+
+
+def test_cli_write_baseline(tmp_path, capsys):
+    f = tmp_path / "mod.py"
+    f.write_text("import time\n\n\ndef handler():\n    return time.time()\n")
+    bl = tmp_path / "bl.txt"
+    assert (
+        cli_main([str(f), "--select", "CLK-001", "--baseline", str(bl),
+                  "--write-baseline"])
+        == 0
+    )
+    assert bl.is_file() and "CLK-001" in bl.read_text()
+    assert (
+        cli_main([str(f), "--select", "CLK-001", "--baseline", str(bl)]) == 0
+    )
+    # --no-baseline surfaces the grandfathered finding again
+    assert (
+        cli_main([str(f), "--select", "CLK-001", "--baseline", str(bl),
+                  "--no-baseline"])
+        == 1
+    )
