@@ -93,6 +93,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="tokens per device dispatch for --decode device",
     )
     p.add_argument(
+        "--spec-draft", type=int, default=0,
+        help="self-speculative decoding: up to K prompt-lookup draft tokens "
+        "(n-gram matches over the request's own prompt + output — no draft "
+        "model) verified per decode step in ONE weight read; greedy output "
+        "is bit-identical to plain decode, sampled output preserves the "
+        "distribution (Leviathan rejection sampling). Wins on repetitive/"
+        "structured output, degenerates gracefully when acceptance "
+        "collapses. 0 (default) = off; single-chip --decode device only",
+    )
+    p.add_argument(
+        "--spec-ngram", type=int, default=3,
+        help="widest n-gram the prompt-lookup drafter matches (falls "
+        "through to shorter n-grams; --spec-draft must be > 0)",
+    )
+    p.add_argument(
         "--cache-dtype",
         choices=["auto", "bf16", "f32", "i8"],
         default="auto",
@@ -258,6 +273,9 @@ def generate(args, benchmark: bool) -> None:
             first_dev, on_token, args.temperature, args.topp,
             seed=sampler.seed, chunk=args.decode_chunk, limit=args.steps,
             key=key, first_prev=prompt_tokens[-1],
+            spec_draft=getattr(args, "spec_draft", 0),
+            spec_ngram=getattr(args, "spec_ngram", 3),
+            prompt_tokens=prompt_tokens,
         )
         print_p_line()  # zero-token streams (immediate BOS) still report P
     else:
@@ -351,6 +369,9 @@ def chat(args) -> None:
                 first_dev, on_token, args.temperature, args.topp,
                 seed=turn_seed, chunk=args.decode_chunk,
                 limit=seq_len, key=key, first_prev=tokens[-1],
+                spec_draft=getattr(args, "spec_draft", 0),
+                spec_ngram=getattr(args, "spec_ngram", 3),
+                prompt_tokens=tokens,
             )
         else:
             prev = tokens[-1]

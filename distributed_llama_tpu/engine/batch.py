@@ -72,6 +72,7 @@ import numpy as np
 
 from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket, next_pow2
+from distributed_llama_tpu.engine.speculative import PromptLookupDrafter
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 from distributed_llama_tpu.ops import kv_cache as kvc
@@ -96,13 +97,11 @@ def _gather_pages(page: int, slab, pool, page_ids, dest_page, row):
     ``dest_page`` across every layer (the admission-time prefix bind:
     correctness-first copy — the row gets its OWN bytes, so nothing it does
     later can touch the immutable tree pages). The donated slab aliases in
-    place; the pool is read-only here."""
+    place; the pool is read-only here. The fused slab leaf takes both pool
+    halves' pages in one coalesced scatter per layer."""
     return [
-        (
-            kvc.gather_pages_to_row(sk, pk, page_ids, dest_page, row, page),
-            kvc.gather_pages_to_row(sv, pv, page_ids, dest_page, row, page),
-        )
-        for (sk, sv), (pk, pv) in zip(slab, pool)
+        kvc.fused_gather_pages(leaf, pk, pv, page_ids, dest_page, row, page)
+        for leaf, (pk, pv) in zip(slab, pool)
     ]
 
 
@@ -110,32 +109,31 @@ def _gather_pages(page: int, slab, pool, page_ids, dest_page, row):
 def _publish_pages(page: int, slab, pool, page_ids, src_page, row):
     """Copy slab row ``row``'s page slots ``src_page`` into pool pages
     ``page_ids`` across every layer (the post-prefill publish). The donated
-    pool aliases in place; the slab is read-only here."""
+    pool aliases in place; the slab is read-only here (``leaf[0]``/
+    ``leaf[1]`` are contiguous views of the fused leaf)."""
     return [
         (
-            kvc.publish_row_pages(pk, sk, row, src_page, page_ids, page),
-            kvc.publish_row_pages(pv, sv, row, src_page, page_ids, page),
+            kvc.publish_row_pages(pk, leaf[0], row, src_page, page_ids, page),
+            kvc.publish_row_pages(pv, leaf[1], row, src_page, page_ids, page),
         )
-        for (sk, sv), (pk, pv) in zip(slab, pool)
+        for leaf, (pk, pv) in zip(slab, pool)
     ]
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
 def _slab_prefill_single(cfg: LlamaConfig, params, tokens, slab, row, pos, n_real):
     """Prefill ``tokens`` into slab row ``row`` (single chip): the row is
-    extracted as an ordinary single-stream cache, run through the normal
-    forward (blocked attention, i8 quantization, MoE bucketing — all
-    reused), and written back; the donated slab aliases every other row in
-    place. Returns (logits [T, vocab], new slab)."""
-    row_cache = [
-        (kvc.slab_take_row(k, row), kvc.slab_take_row(v, row)) for k, v in slab
-    ]
+    extracted as an ordinary single-stream fused cache, run through the
+    normal forward (blocked attention, i8 quantization, MoE bucketing,
+    coalesced K/V updates — all reused), and written back; the donated slab
+    aliases every other row in place. Returns (logits [T, vocab], new slab)."""
+    row_cache = [kvc.fused_take_row(leaf, row) for leaf in slab]
     logits, new_rows = llama.forward_tokens(
         cfg, params, tokens, row_cache, pos, n_real=n_real
     )
     new_slab = [
-        (kvc.slab_put_row(k, nk, row), kvc.slab_put_row(v, nv, row))
-        for (k, v), (nk, nv) in zip(slab, new_rows)
+        kvc.fused_put_row(leaf, new_leaf, row)
+        for leaf, new_leaf in zip(slab, new_rows)
     ]
     return logits, new_slab
 
@@ -174,6 +172,15 @@ class BatchStream:
         # False skips BOTH the admission match and the post-prefill publish
         # for this row (ISSUE 4); serving restores True between requests
         self.prefix_cache_enabled = True
+        # speculative decode (scheduler spec mode): this row's host-side
+        # prompt-lookup corpus (prompt + emitted tokens, extended at chunk
+        # delivery) and its lazily-built drafter. ``_spec_on`` False rides
+        # the shared verify dispatches with ZERO drafts — a plain decode
+        # step on the same weight read, which is how spec and non-spec
+        # requests mix in one slab
+        self._history: list[int] = []
+        self._drafter: PromptLookupDrafter | None = None
+        self._spec_on = False
         # a chunk failure retires ONLY this row (faults.RowQuarantined /
         # StallTimeout / DeadlineExceeded, set by the scheduler under its
         # lock); next_token raises it, surviving co-batched rows keep
@@ -208,6 +215,9 @@ class BatchStream:
         self._fetch_error = None
         self.deadline = None
         self.prefix_cache_enabled = True
+        self._history = []
+        self._drafter = None
+        self._spec_on = False
 
     def rollback(self, pos: int) -> None:
         """Rewind to ``pos`` (prefix-cache reuse / early-stop contract).
@@ -330,13 +340,24 @@ class BatchStream:
         limit: int | None = None,
         key=None,
         first_prev: int | None = None,
+        spec_draft: int = 0,
+        spec_ngram: int = 3,
+        prompt_tokens=None,
     ) -> int:
         """EngineStream.stream_decode over the shared batched dispatch: this
         stream joins the scheduler's active set and consumes its row of
         every batched chunk; other streams' chunks ride the same weight
         reads. ``chunk`` is accepted for signature parity but the scheduler's
         shared chunk size governs (all coalesced rows must step together).
-        Owns the early-stop rollback contract; returns tokens consumed."""
+        Owns the early-stop rollback contract; returns tokens consumed.
+
+        With the scheduler in spec mode (``spec_draft`` on the
+        BatchScheduler), every dispatch is a batched VERIFY step and rows
+        advance a variable number of positions per chunk; ``spec_draft`` 0
+        on the call keeps this row's drafts empty (a plain decode step
+        riding the shared verify read), which is how spec and non-spec
+        requests mix in one slab. ``spec_ngram`` is accepted for signature
+        parity — the scheduler's shared drafter config governs."""
         engine = self.engine
         sched = self.scheduler
         if key is None:
@@ -344,12 +365,27 @@ class BatchStream:
         start_pos = self.pos
         stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
         fused_first = first_prev is not None
+        spec_mode = sched.spec_draft > 0
         prev = first_prev if fused_first else int(first_token)
         consumed = 0
         keep = True
+        if spec_mode:
+            # the drafter needs host token values: fetch the fused first
+            # token BEFORE joining (the plain path's fetch-overlap trick is
+            # traded for draft context — one round trip buys up to k+1
+            # tokens per subsequent step)
+            if fused_first:
+                tok = self._fetch_fused_first(first_token)
+                consumed = 1
+                keep = on_token(prev, tok)
+                prev = tok
+            self._history = [int(t) for t in (prompt_tokens or [])]
+            self._history.append(prev)
+            self._spec_on = bool(spec_draft and spec_draft > 0)
+            first_token = prev  # host int: the next verify window's feed[0]
         sched._join(self, first_token, temperature, topp, key)
         try:
-            if fused_first:
+            if fused_first and not spec_mode:
                 # dispatch chunk 1 before the fused fetch so the scalar
                 # fetch overlaps the chunk's compute (the prefill_device
                 # round-trip elision, batched)
@@ -409,6 +445,8 @@ class BatchScheduler:
         kv_pages: int | None = None,
         page_size: int = 64,
         prefill_chunk: int = 0,
+        spec_draft: int = 0,
+        spec_ngram: int = 3,
     ):
         tp_engine = engine._tp_engine
         if tp_engine is not None and not hasattr(tp_engine, "batched_decode_chunk"):
@@ -464,6 +502,28 @@ class BatchScheduler:
                 self._pool = llama.init_page_pool(
                     engine.cfg, kv_pages, page_size, dtype=engine.cache_dtype
                 )
+        # self-speculative decode (ISSUE 6): spec_draft > 0 turns every
+        # batched dispatch into a VERIFY step — per-row prompt-lookup
+        # drafts scored in one weight read, rows advancing a variable
+        # number of positions per step. Misconfiguration soft-disables
+        # (spec is a perf mode; it must never take batched serving down)
+        self.spec_draft = 0
+        self.spec_ngram = max(1, int(spec_ngram))
+        if spec_draft and int(spec_draft) > 0:
+            if tp_engine is not None:
+                print(
+                    "⚠️ speculative decode disabled: the batched verify "
+                    "forward is single-chip only for now (the tp verify "
+                    "needs the sharded multi-token program)"
+                )
+            elif engine.cfg.is_moe:
+                print(
+                    "⚠️ speculative decode disabled: MoE verify windows "
+                    "would route T>1 rows through the prefill expert path "
+                    "(no decode parity contract)"
+                )
+            else:
+                self.spec_draft = int(spec_draft)
         # fault tolerance (ISSUE 3): bounded retry with exponential backoff
         # for transient dispatch/fetch failures, an optional stall watchdog,
         # and the bind-once fault-injection plan (NULL_PLAN when no chaos
@@ -850,12 +910,66 @@ class BatchScheduler:
                     continue
             self._fetch(pend, gen)
 
+    def _run_dispatch_locked(self, joined, dispatch_fn, fail_msg: str):
+        """The shared dispatch frame of the chunk and spec-verify paths
+        (cond lock held): raise the pipeline depth (released when the fetch
+        drains), run ``dispatch_fn`` under the bounded retry-with-backoff
+        loop (``batch.dispatch`` fault hook fired per attempt), and on
+        exhausted retries retire every joined row CLEANLY with a typed
+        ``fail_msg`` quarantine — no position advanced, the scheduler keeps
+        serving. Returns ``dispatch_fn``'s result, or None after retiring
+        the rows. KeyboardInterrupt/SystemExit release the depth and
+        propagate (they must abort, not retry into quarantines)."""
+        engine = self.engine
+        with engine._depth_lock:
+            engine._pipeline_depth += 1  # released when the fetch drains
+        result = None
+        error: Exception | None = None
+        try:
+            for attempt in range(self.retries + 1):
+                try:
+                    self._faults.fire("batch.dispatch")
+                    result = dispatch_fn()
+                    error = None
+                    break
+                except Exception as e:
+                    # transient failures (an injected dispatch raise, a flaky
+                    # runtime) retry with backoff — briefly blocking joins
+                    # (the cond lock is held) is the cost of a coherent
+                    # active set
+                    error = e
+                    if attempt < self.retries:
+                        engine._tel.dispatch_retries.inc()
+                        # bounded backoff (retries * backoff_s) with the cond
+                        # held — the one sanctioned block under this lock
+                        time.sleep(self.retry_backoff_s * (2 ** attempt))  # dllama: noqa[LCK-002]
+        except BaseException:
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+            raise
+        if error is not None:
+            with engine._depth_lock:
+                engine._pipeline_depth -= 1
+            tel = engine._tel
+            tel.rows_quarantined.inc(len(joined))
+            for s in joined:
+                err = faults.RowQuarantined(fail_msg)
+                err.__cause__ = error
+                s._fetch_error = err
+            self._cond.notify_all()
+            return None
+        return result
+
     def _dispatch_locked(self) -> None:
         """Build and dispatch one batched chunk from the joined streams
         (cond lock held; the dispatch itself is asynchronous). Rows inside
         the bucket that are not joined ride along masked-inactive: their
-        cache writes DROP and their outputs are discarded."""
+        cache writes DROP and their outputs are discarded. In spec mode the
+        chunk is a batched VERIFY step instead (``_dispatch_spec_locked``)."""
         engine = self.engine
+        if self.spec_draft > 0:
+            self._dispatch_spec_locked()
+            return
         joined = [s for s in self._streams if s._joined]
         if not joined:
             return
@@ -877,70 +991,36 @@ class BatchScheduler:
             [s._key if s._joined and s._key is not None else zero_key for s in rows]
         )
         sw = Stopwatch()
-        with engine._depth_lock:
-            engine._pipeline_depth += 1  # released when the fetch drains
-        tokens = new_keys = None
-        error: Exception | None = None
-        try:
-            for attempt in range(self.retries + 1):
-                try:
-                    self._faults.fire("batch.dispatch")
-                    with engine._tel.span(
-                        "batch_decode_chunk", bucket=bucket, active=len(joined),
-                        steps=self.chunk,
-                    ):
-                        if engine._tp_engine is None:
-                            from distributed_llama_tpu.models import sampling
 
-                            tokens, self._slab, new_keys = sampling.decode_chunk_batched(
-                                engine.cfg, engine.params, first, self._slab, pos,
-                                active, self.chunk, temps, topps, keys,
-                            )
-                        else:
-                            tokens, self._slab, new_keys = (
-                                engine._tp_engine.batched_decode_chunk(
-                                    engine.params, first, self._slab, pos, active,
-                                    self.chunk, temps, topps, keys,
-                                )
-                            )
-                    error = None
-                    break
-                except Exception as e:
-                    # transient failures (an injected dispatch raise, a flaky
-                    # runtime) retry with backoff — briefly blocking joins
-                    # (the cond lock is held) is the cost of a coherent
-                    # active set. Exception only: KeyboardInterrupt/
-                    # SystemExit must abort, not retry
-                    error = e
-                    if attempt < self.retries:
-                        engine._tel.dispatch_retries.inc()
-                        # bounded backoff (retries * backoff_s) with the cond
-                        # held, per the comment above — the one sanctioned
-                        # block under this lock
-                        time.sleep(self.retry_backoff_s * (2 ** attempt))  # dllama: noqa[LCK-002]
-        except BaseException:
-            with engine._depth_lock:
-                engine._pipeline_depth -= 1
-            raise
-        if error is not None:
-            # retries exhausted: retire every joined row CLEANLY — no
-            # position advanced and no slab row was consumed by a completed
-            # program, the rows' requests fail with a typed error, and the
-            # scheduler keeps serving future requests
-            with engine._depth_lock:
-                engine._pipeline_depth -= 1
-            tel = engine._tel
-            tel.rows_quarantined.inc(len(joined))
-            for s in joined:
-                err = faults.RowQuarantined(
-                    "batched chunk dispatch failed after "
-                    f"{self.retries + 1} attempts; this row's request was "
-                    "retired"
-                )
-                err.__cause__ = error
-                s._fetch_error = err
-            self._cond.notify_all()
+        def dispatch():
+            with engine._tel.span(
+                "batch_decode_chunk", bucket=bucket, active=len(joined),
+                steps=self.chunk,
+            ):
+                if engine._tp_engine is None:
+                    from distributed_llama_tpu.models import sampling
+
+                    tokens, self._slab, new_keys = sampling.decode_chunk_batched(
+                        engine.cfg, engine.params, first, self._slab, pos,
+                        active, self.chunk, temps, topps, keys,
+                    )
+                else:
+                    tokens, self._slab, new_keys = (
+                        engine._tp_engine.batched_decode_chunk(
+                            engine.params, first, self._slab, pos, active,
+                            self.chunk, temps, topps, keys,
+                        )
+                    )
+            return tokens, new_keys
+
+        result = self._run_dispatch_locked(
+            joined, dispatch,
+            f"batched chunk dispatch failed after {self.retries + 1} "
+            "attempts; this row's request was retired",
+        )
+        if result is None:
             return
+        tokens, new_keys = result
         for s in joined:
             # the next chunk seeds from this chunk's last token and advanced
             # key — both stay device-resident (no fetch on the critical path)
@@ -950,7 +1030,97 @@ class BatchScheduler:
         if engine._tel.enabled:
             engine._tel.batch_occupancy.set(len(joined) / bucket)
         self._pending = (
-            tokens, [(s, s._epoch) for s in joined], bucket, len(joined), sw,
+            "chunk", tokens, [(s, s._epoch) for s in joined], bucket,
+            len(joined), sw, None,
+        )
+
+    def _dispatch_spec_locked(self) -> None:
+        """Build and dispatch one batched speculative VERIFY step (cond
+        lock held): per joined row, up to ``spec_draft`` prompt-lookup
+        draft tokens from the row's own history ride behind its previous
+        token in a [bucket, k+1] feed window; one
+        ``sampling.spec_verify_chunk_batched`` dispatch scores every row's
+        window in a single weight read and accepts/rejects on device. Rows
+        advance a VARIABLE number of positions — applied at fetch time,
+        because the advance (and the next window's drafts) depend on the
+        fetched results; spec steps therefore never pipeline a second
+        dispatch behind an in-flight fetch."""
+        engine = self.engine
+        if self._fetching:
+            # the next window's drafts depend on THIS step's emitted
+            # tokens: wait for the fetch instead of dispatching blind
+            return
+        joined = [s for s in self._streams if s._joined]
+        if not joined:
+            return
+        bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
+        rows = self._streams[:bucket]
+        T = self.spec_draft + 1
+        S = engine.cfg.seq_len
+        zero_key = jax.random.PRNGKey(0)
+        feed = np.zeros((bucket, T), np.int32)
+        lens = np.zeros(bucket, np.int32)
+        for s in rows:
+            if not s._joined:
+                continue
+            feed[s.row, :] = int(s._first)  # pad tokens: overwritten KV
+            # never draft past seq_len: the window writes pos..pos+T-1 and
+            # out-of-bounds slots drop, but accepted positions must stay
+            # inside the cache
+            budget = max(0, min(self.spec_draft, S - s.pos - 1))
+            if budget > 0 and s._spec_on:
+                if s._drafter is None:
+                    s._drafter = PromptLookupDrafter(
+                        self.spec_draft, max_ngram=self.spec_ngram
+                    )
+                d = s._drafter.draft(s._history, limit=budget)
+                if d:
+                    feed[s.row, 1 : 1 + len(d)] = d
+                    lens[s.row] = len(d)
+        pos = jnp.asarray([s.pos if s._joined else 0 for s in rows], jnp.int32)
+        active = jnp.asarray([s._joined for s in rows], bool)
+        temps = jnp.asarray(
+            [s._temperature if s._joined else 1.0 for s in rows], jnp.float32
+        )
+        topps = jnp.asarray(
+            [s._topp if s._joined else 0.9 for s in rows], jnp.float32
+        )
+        keys = jnp.stack(
+            [s._key if s._joined and s._key is not None else zero_key for s in rows]
+        )
+        sw = Stopwatch()
+        from distributed_llama_tpu.models import sampling
+
+        def dispatch():
+            with engine._tel.span(
+                "spec_verify_chunk", bucket=bucket, active=len(joined),
+                window=T,
+            ):
+                out, self._slab, new_keys = sampling.spec_verify_chunk_batched(
+                    engine.cfg, engine.params, jnp.asarray(feed),
+                    self._slab, pos, active, jnp.asarray(lens), temps,
+                    topps, keys,
+                )
+            return out, new_keys
+
+        result = self._run_dispatch_locked(
+            joined, dispatch,
+            f"batched verify dispatch failed after {self.retries + 1} "
+            "attempts; this row's request was retired",
+        )
+        if result is None:
+            return
+        out, new_keys = result
+        for s in joined:
+            s._key = new_keys[s.row]  # device-resident; pos/_first wait for
+            # the fetch (the advance is variable and data-dependent)
+        tel = engine._tel
+        if tel.enabled:
+            tel.batch_occupancy.set(len(joined) / bucket)
+            tel.spec_draft_tokens.inc(int(lens.sum()))
+        self._pending = (
+            "spec", out, [(s, s._epoch) for s in joined], bucket, len(joined),
+            sw, lens.copy(),
         )
 
     def _fetch(self, pend, gen: int) -> None:
@@ -965,7 +1135,7 @@ class BatchScheduler:
         generation check keeps a watchdog-killed fetch from delivering at
         all."""
         engine = self.engine
-        tokens_dev, snapshot, bucket, n_active, sw = pend
+        mode, tokens_dev, snapshot, bucket, n_active, sw, spec_lens = pend
         toks = None
         error: Exception | None = None
         try:
@@ -1019,6 +1189,10 @@ class BatchScheduler:
             # deliver nothing
             with self._cond:
                 self._cond.notify_all()
+            return
+        if mode == "spec":
+            self._deliver_spec(toks, snapshot, sw, spec_lens, error)
+            self._drain_if_idle()
             return
         per_token_ms = sw.elapsed_ms() / self.chunk
         # the I/T split may trigger a transfer re-measurement (a device
@@ -1087,3 +1261,86 @@ class BatchScheduler:
         # re-check the idle-drain condition now that the fetch is done —
         # the one-pending-slot invariant bounds the recursion.
         self._drain_if_idle()
+
+    def _deliver_spec(self, toks, snapshot, sw, lens, error) -> None:
+        """Deliver one fetched batched VERIFY step: row ``b``'s column is
+        ``[n_emit, tokens...]`` — apply its VARIABLE position advance,
+        extend its lookup history, and queue the emitted tokens. Runs with
+        fetch ownership already claimed (``_fetch``); corrupt or
+        chaos-targeted rows quarantine individually, survivors delivered
+        bit-identically (the ``engine.spec_verify`` site's contract)."""
+        engine = self.engine
+        tel = engine._tel
+        vocab = engine.cfg.vocab_size
+        step_ms = sw.elapsed_ms()
+        bad: dict[int, BaseException | None] = {}
+        emits: dict[int, list[int]] = {}
+        entries: dict[int, TokenStats] = {}
+        if toks is not None:
+            for s, _ in snapshot:
+                # the chaos hook: a row-targeted raise quarantines ONLY this
+                # row while its column is validated (outside the cond lock,
+                # like the batch.row corruption hook)
+                try:
+                    self._faults.fire("engine.spec_verify", row=s.row)
+                except Exception as e:
+                    bad[s.row] = e
+                    continue
+                # validate against the row's OWN draft budget, not the
+                # global window: a corrupt n_emit in (lens+1, T] would pass
+                # a T bound (the token tail is zero-padded, in-vocab) and
+                # advance pos past the dispatch-side seq_len clamp
+                n_emit = int(toks[s.row, 0])
+                if not 1 <= n_emit <= int(lens[s.row]) + 1:
+                    bad[s.row] = None
+                    continue
+                col = toks[s.row, 1 : 1 + n_emit]
+                if not ((col >= 0) & (col < vocab)).all():
+                    bad[s.row] = None  # NaN-logits class corruption
+                    continue
+                emits[s.row] = [int(t) for t in col]
+                # the I/T split may probe the device under TP — build every
+                # row's stats entry BEFORE taking the scheduler lock, same
+                # rule as the plain chunk delivery
+                entries[s.row] = engine._split_stats(step_ms, n_tokens=n_emit)
+        delivered_rows = 0
+        delivered_tokens = 0
+        with self._cond:
+            self._fetching = False
+            for s, epoch in snapshot:
+                if not (s._joined and s._epoch == epoch):
+                    continue
+                if toks is None or s.row not in emits:
+                    err = faults.RowQuarantined(
+                        "batch row retired: verify "
+                        + (
+                            f"fetch failed after {self.retries + 1} attempts"
+                            if toks is None
+                            else "step failed or produced corrupt tokens"
+                        )
+                    )
+                    err.__cause__ = error if toks is None else bad.get(s.row)
+                    s._fetch_error = err
+                    tel.rows_quarantined.inc()
+                    continue
+                col = emits[s.row]
+                n_emit = len(col)
+                s.pos += n_emit  # the variable advance (deferred from dispatch)
+                s._first = col[-1]  # host int: the next window's feed[0]
+                s._history.extend(col)
+                s._queue.extend(col)
+                s.stats.append(entries[s.row])
+                delivered_rows += 1
+                delivered_tokens += n_emit
+                if tel.enabled:
+                    tel.kv_occupancy.set(min(s.pos / engine.cfg.seq_len, 1.0))
+                    tel.spec_accepted_tokens.inc(n_emit - 1)
+                    if int(lens[s.row]) > 0:
+                        tel.spec_acceptance.observe((n_emit - 1) / int(lens[s.row]))
+                    tel.spec_step_advance.observe(n_emit)
+            self._cond.notify_all()
+        if tel.enabled and delivered_tokens:
+            tel.tokens_generated.inc(delivered_tokens)
+            tel.decode_latency.observe(
+                step_ms * delivered_rows / delivered_tokens / 1000.0
+            )

@@ -550,6 +550,9 @@ class EngineStream:
         limit: int | None = None,
         key=None,
         first_prev: int | None = None,
+        spec_draft: int = 0,
+        spec_ngram: int = 3,
+        prompt_tokens=None,
     ) -> int:
         """Drive the chunked fast decode with host-side stop handling: the
         shared consumption loop of CLI generate/chat and the API server.
@@ -565,7 +568,33 @@ class EngineStream:
         scalar from :meth:`prefill_device` that the caller has NOT seen yet —
         it is ALSO yielded to ``on_token`` as the first decoded token (its
         host value arrives with the first fetched chunk), with ``first_prev``
-        (the prompt's last token) as its predecessor."""
+        (the prompt's last token) as its predecessor.
+
+        ``spec_draft`` > 0 routes through self-speculative decoding
+        (:meth:`_stream_decode_spec`): prompt-lookup drafts over
+        ``prompt_tokens`` + the emitted output are verified k at a time in
+        one weight read per step. Single-chip dense models only — other
+        backends fall back to the chunked path, and so do MoE models (a
+        T>1 verify window routes through the prefill expert path, which
+        has no decode parity contract — same gate as the batch
+        scheduler's). Greedy output is identical either way."""
+        if spec_draft and spec_draft > 0:
+            if self.engine._tp_engine is None and not self.engine.cfg.is_moe:
+                return self._stream_decode_spec(
+                    first_token, on_token, temperature, topp, seed, spec_draft,
+                    spec_ngram, limit, key, first_prev, prompt_tokens,
+                )
+            # once per engine, not per request: the operator asked for spec
+            # on a backend without it — say so instead of silently serving
+            # the plain path (the batch scheduler prints the same warning)
+            if not getattr(self.engine, "_spec_fallback_warned", False):
+                self.engine._spec_fallback_warned = True
+                reason = (
+                    "single-chip backend only for now"
+                    if self.engine._tp_engine is not None
+                    else "MoE verify windows have no decode parity contract"
+                )
+                print(f"⚠️ --spec-draft ignored: {reason}; plain chunked decode")
         start_pos = self.pos
         consumed = 0
         fused_first = first_prev is not None
@@ -599,6 +628,122 @@ class EngineStream:
         # of this request was computed mid-flight and used the cached value;
         # without this hook a device-decode-only server would never measure)
         self.engine._maybe_refresh_transfer()
+        return consumed
+
+    def _stream_decode_spec(
+        self,
+        first_token,
+        on_token,
+        temperature: float,
+        topp: float,
+        seed: int,
+        spec_draft: int,
+        spec_ngram: int,
+        limit: int | None,
+        key,
+        first_prev: int | None,
+        prompt_tokens,
+    ) -> int:
+        """Self-speculative decode (``--spec-draft k``): per step the host
+        drafts up to k tokens by prompt lookup over the request's own
+        prompt + output, ONE verify forward scores draft + bonus positions
+        in a single weight read, and the on-device accept/reject keeps the
+        longest valid prefix — 1..k+1 tokens emitted per weight read
+        instead of exactly 1. Greedy output is bit-identical to plain
+        decode (tests/test_speculative.py); sampled output preserves the
+        target distribution via Leviathan rejection sampling.
+
+        Unlike :meth:`generate_chunks` this loop cannot pipeline: the next
+        step's drafts depend on THIS step's emitted tokens, so each verify
+        is dispatched and fetched synchronously (the fetch is k+2 int32s).
+        The trade is deliberate — on accepting workloads one round trip
+        buys several tokens. ``prompt_tokens`` seeds the lookup corpus
+        (without it only the emitted output can match). Single chip only;
+        the caller routes other backends to the chunked path."""
+        from distributed_llama_tpu.engine.speculative import PromptLookupDrafter
+        from distributed_llama_tpu.models import sampling
+
+        engine = self.engine
+        if key is None:
+            key = jax.random.PRNGKey(seed)
+        stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
+        drafter = PromptLookupDrafter(spec_draft, max_ngram=spec_ngram)
+        # the lookup corpus: prompt + everything emitted (first_token is
+        # appended below — callers pass the prompt WITHOUT it)
+        history = [int(t) for t in (prompt_tokens if prompt_tokens is not None else [])]
+        tel = engine._tel
+        start_pos = self.pos
+        fused = first_prev is not None
+        consumed = 0
+        keep = True
+        try:
+            if fused:
+                # the drafter needs the fused first token's host value
+                # before anything can be proposed, so the scalar fetch
+                # cannot overlap a chunk here — it IS the step boundary
+                prev = self._fetch_fused_first(first_token)
+                consumed = 1
+                history.append(prev)
+                keep = on_token(first_prev, prev)
+            else:
+                prev = int(first_token)
+                history.append(prev)
+            while keep is not False:
+                fed = consumed - 1 if fused else consumed
+                if start_pos + fed >= stop:
+                    break
+                # the verify window never writes past seq_len: shrink T at
+                # the context tail (an exact-length compile, same policy as
+                # the prefill buckets near the limit)
+                T = min(spec_draft + 1, engine.cfg.seq_len - self.pos)
+                if T < 1:
+                    break
+                draft = drafter.draft(history, limit=T - 1)
+                feed = np.full(T, prev, np.int32)  # pad tokens are overwritten KV
+                feed[1 : 1 + len(draft)] = draft
+                engine._faults.fire("engine.spec_verify")
+                sw = Stopwatch()
+                with tel.span(
+                    "spec_verify", pos=self.pos, window=T, drafted=len(draft)
+                ):
+                    out_dev, self.cache, key = sampling.spec_verify_step(
+                        engine.cfg, engine.params, jnp.asarray(feed), self.cache,
+                        jnp.int32(self.pos), jnp.int32(len(draft)),
+                        jnp.float32(temperature), jnp.float32(topp), key,
+                    )
+                    out = np.asarray(out_dev)  # [T+1]: n_emit, tokens...
+                n_emit = max(1, min(int(out[0]), T))
+                toks = [int(t) for t in out[1 : 1 + n_emit]]
+                self.pos += n_emit
+                entry = engine._split_stats(sw.elapsed_ms(), n_tokens=n_emit)
+                self.stats.append(entry)
+                if tel.enabled:
+                    tel.tokens_generated.inc(n_emit)
+                    tel.decode_latency.observe(sw.elapsed_ms() / n_emit / 1000.0)
+                    tel.kv_occupancy.set(self.pos / engine.cfg.seq_len)
+                    tel.spec_draft_tokens.inc(len(draft))
+                    tel.spec_accepted_tokens.inc(n_emit - 1)
+                    if draft:
+                        tel.spec_acceptance.observe((n_emit - 1) / len(draft))
+                    tel.spec_step_advance.observe(n_emit)
+                for t in toks:
+                    consumed += 1
+                    history.append(t)
+                    keep = on_token(prev, t)
+                    prev = t
+                    fed = consumed - 1 if fused else consumed
+                    if keep is False or start_pos + fed >= stop:
+                        break
+        finally:
+            # positions beyond the last consumed token (a rejected-draft
+            # overshoot, or tokens emitted past an early stop) are stale:
+            # rewind exactly like the chunked path's rollback contract
+            fed = max(consumed - 1, 0) if fused else consumed
+            self.rollback(min(start_pos + fed, self.pos))
+        # end-of-stream quiescent point: same cadence hook as the chunked
+        # path (a no-op on today's single-chip-only spec route, but the
+        # contract belongs to every stream_decode exit)
+        engine._maybe_refresh_transfer()
         return consumed
 
     # ------------------------------------------------------------------
@@ -735,6 +880,9 @@ class InferenceEngine:
         # eagerly allocating its KV cache would hold one full cache of HBM
         # dead next to the scheduler's slab
         self._default: EngineStream | None = None
+        # once-per-engine "--spec-draft ignored" diagnostic latch (the spec
+        # route is single-chip dense only; see EngineStream.stream_decode)
+        self._spec_fallback_warned = False
         self._transfer_ms: float | None = None  # measured lazily under TP/SP
         self._transfer_measured_at = 0  # token count at the last measurement
         self._pipeline_depth = 0  # >0 while a speculative chunk is in flight

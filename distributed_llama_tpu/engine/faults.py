@@ -24,6 +24,12 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
 ``engine.forward``  raise at any single-stream forward dispatch
 ``engine.decode_dispatch``  raise at a single-stream decode-chunk dispatch
 ``engine.fetch``    raise/delay at the single-stream chunk fetch
+``engine.spec_verify``  raise at a speculative-decode verify step: fired at
+                    the single-stream verify dispatch, and per row while a
+                    batched verify's results are validated — a ``row=``
+                    rule there quarantines ONLY the targeted row, its
+                    co-batched survivors delivered bit-identically
+                    (engine/batch.py ``_fetch``)
 ``tp.transfer``     raise/delay inside the transfer probe (the engine keeps
                     its last estimate instead of dying)
 ``server.send``     raise ``BrokenPipeError`` from the SSE chunk writer
@@ -105,6 +111,7 @@ SITES = (
     "engine.forward",
     "engine.decode_dispatch",
     "engine.fetch",
+    "engine.spec_verify",
     "tp.transfer",
     "server.send",
 )

@@ -201,13 +201,22 @@ def attention(
     q, k, v = project_qkv(cfg, lp, x, rope_rows)
     Hl, Kl = q.shape[1], k.shape[1]
 
-    keys = kvc.update_rows(cache_l[0], k, pos)  # [S, Kl, hd]
-    values = kvc.update_rows(cache_l[1], v, pos)
-    # per-layer TUPLE caches (the layered layout) update in place; stacking
-    # into a [2, S, Kl, hd] array would copy the layer's ENTIRE cache every
-    # step (~1.3 ms/token across 32 layers of a 7B, profiled) because XLA
-    # cannot alias a stack of two updated slices back onto the original
-    new_cache = (keys, values) if isinstance(cache_l, tuple) else jnp.stack([keys, values])
+    if kvc.is_fused_leaf(cache_l):
+        # fused [2, S, Kl, hd] leaf: keys AND values land in ONE coalesced
+        # dynamic_update_slice (the leading 2-axis is fully covered, so the
+        # donated leaf aliases in place — unlike updating the two halves
+        # separately and re-stacking, which copies the layer's entire cache).
+        # This halves the per-layer update op count PERF.md puts on the
+        # decode critical path, and a T>1 verify window writes all of its
+        # draft K/V in the same single update.
+        new_cache = kvc.fused_update_rows(cache_l, k, v, pos)
+        keys, values = new_cache[0], new_cache[1]
+    else:
+        # per-layer TUPLE caches (the tp/sp/ep backends' sharded layout)
+        # update in place per half
+        keys = kvc.update_rows(cache_l[0], k, pos)  # [S, Kl, hd]
+        values = kvc.update_rows(cache_l[1], v, pos)
+        new_cache = (keys, values)
 
     kv_mul = Hl // Kl
     # score/value einsums run with operands in the CACHE dtype (bf16 for an
@@ -367,9 +376,15 @@ def attention_batched(
     Hl, Kl = q.shape[1], k.shape[1]
 
     write_slot = jnp.where(active & (pos < S), pos, S)  # S = dropped
-    keys = kvc.update_row_batched(cache_l[0], k, write_slot)
-    values = kvc.update_row_batched(cache_l[1], v, write_slot)
-    new_cache = (keys, values)
+    if kvc.is_fused_leaf(cache_l):
+        # fused slab leaf [2, B, S, Kl, hd]: one coalesced scatter writes
+        # every row's key AND value (see the fused note in attention())
+        new_cache = kvc.fused_update_row_batched(cache_l, k, v, write_slot)
+        keys, values = new_cache[0], new_cache[1]
+    else:
+        keys = kvc.update_row_batched(cache_l[0], k, write_slot)
+        values = kvc.update_row_batched(cache_l[1], v, write_slot)
+        new_cache = (keys, values)
 
     kv_mul = Hl // Kl
     cdt = kvc.compute_dtype(keys)
@@ -436,6 +451,116 @@ def forward_step_batched(
     return final_logits(cfg, params, x), type(cache)(new_layers)
 
 
+def attention_verify_batched(
+    cfg: LlamaConfig,
+    x: jax.Array,  # [B, T, dim] — T-token verify window per sequence
+    lp: Params,
+    cache_l,  # fused [2, B, S, Kl, hd] slab leaf (or (keys, values) tuple)
+    pos: jax.Array,  # [B] absolute position of each row's window start
+    rope_rows: jax.Array,  # [B, T, hd/2, 2] per-(row, offset) rope rows
+    active: jax.Array,  # [B] bool — False rows verify garbage, write nothing
+) -> tuple[jax.Array, jax.Array]:
+    """One speculative-verify attention step of B independent T-token
+    windows (T = draft k + 1): row ``b``'s query ``t`` sits at ``pos[b]+t``,
+    writes its K/V there, and attends its own slab row causally. The write
+    is ONE coalesced scatter per layer covering all B·T keys AND values;
+    out-of-bounds slots (inactive rows, context-limit clamps) drop, so a
+    retired row's cache stays byte-identical. Returns
+    (attention mix [B, T, Hl*hd], updated cache)."""
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
+    B, T = x.shape[0], x.shape[1]
+    S = cache_l[0].shape[1]
+    hd = cfg.head_size
+    # projections/rope are position-free per row: run them on the flattened
+    # [B*T] token axis (one matmul per matrix — the whole point of scoring
+    # draft + bonus positions in a single weight read)
+    q, k, v = project_qkv(
+        cfg, lp, x.reshape(B * T, -1), rope_rows.reshape(B * T, *rope_rows.shape[2:])
+    )
+    Hl, Kl = q.shape[1], k.shape[1]
+    q = q.reshape(B, T, Kl * (Hl // Kl), hd)
+    k = k.reshape(B, T, Kl, hd)
+    v = v.reshape(B, T, Kl, hd)
+
+    slots = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    slots = jnp.where(active[:, None] & (slots < S), slots, S)  # S = dropped
+    if kvc.is_fused_leaf(cache_l):
+        new_cache = kvc.fused_update_verify_batched(cache_l, k, v, slots)
+        keys, values = new_cache[0], new_cache[1]
+    else:
+        b_idx = jnp.arange(B)[:, None]
+        keys = kvc.scatter_verify_rows(cache_l[0], b_idx, slots, k)
+        values = kvc.scatter_verify_rows(cache_l[1], b_idx, slots, v)
+        new_cache = (keys, values)
+
+    kv_mul = Hl // Kl
+    cdt = kvc.compute_dtype(keys)
+    prec = kvc.einsum_precision(keys)
+    qg = q.reshape(B, T, Kl, kv_mul, hd).astype(cdt)
+    read_pos = jnp.where(active, pos, 0)
+    if S % ATT_CHUNK == 0 and S > ATT_CHUNK:
+        from distributed_llama_tpu.ops.attention import batched_verify_attention
+
+        att = batched_verify_attention(
+            qg.astype(jnp.float32), keys, values, read_pos, ATT_CHUNK
+        ).astype(jnp.float32)
+        return att.reshape(B, T, Hl * hd), new_cache
+    keys_b = keys if keys.shape[0] == B else kvc.slice_rows_batched(keys, 0, S, rows=B)
+    values_b = (
+        values if values.shape[0] == B else kvc.slice_rows_batched(values, 0, S, rows=B)
+    )
+    scores = kvc.scores_einsum_verify(qg, keys_b, prec) / jnp.sqrt(jnp.float32(hd))
+    # causal mask per (row, offset): query t of row b sees slots 0..pos[b]+t
+    q_pos = read_pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    mask = jnp.arange(S)[None, None, :] <= q_pos[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    att = kvc.mix_einsum_verify(weights, values_b, cdt, prec).reshape(B, T, Hl * hd)
+    return att, new_cache
+
+
+def forward_verify_batched(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,  # int32 [B, T] — [prev, draft_1..draft_k] per row
+    cache,  # list of per-layer fused slab leaves (llama.init_batch_cache)
+    pos: jax.Array,  # int32 [B] per-row positions of tokens[:, 0]
+    active: jax.Array,  # bool [B]
+    axis_name: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """The speculative-decode verify forward: score every row's T-token
+    window (previous token + k prompt-lookup drafts) in ONE weight read.
+    ``logits[b, t]`` is the next-token distribution after consuming
+    ``tokens[b, :t+1]`` — the accept/reject pass (sampling._spec_accept_row)
+    compares drafts against it positionwise. Causally masked at a per-row
+    position offset, so it is the batched multi-token generalization of
+    :func:`forward_step_batched` (whose T == 1 case it reproduces
+    bit-exactly); the chunked-prefill machinery supplies the attention and
+    cache-write building blocks. Returns (logits f32 [B, T, vocab],
+    updated slab cache)."""
+    if not isinstance(cache, (list, tuple)):
+        raise ValueError("batched verify requires the layered (per-layer list) cache")
+    B, T = tokens.shape
+    x = embed(cfg, params, tokens.reshape(-1)).reshape(B, T, -1)
+    offsets = pos[:, None] + jnp.arange(T)[None, :]
+    rope_rows = params["rope_table"][jnp.clip(offsets, 0, cfg.seq_len - 1)]
+    layers = params["layers"]
+    if not isinstance(layers, (list, tuple)):
+        raise ValueError("batched verify requires the per-layer-list params layout")
+    new_layers = []
+    for l, lp in enumerate(layers):
+        att, nc = attention_verify_batched(
+            cfg, x, lp, cache[l], pos, rope_rows, active
+        )
+        x = block_tail(
+            cfg, x.reshape(B * T, -1), att.reshape(B * T, -1), lp, axis_name
+        ).reshape(B, T, -1)
+        new_layers.append(nc)
+    logits = final_logits(cfg, params, x.reshape(B * T, -1))
+    return logits.reshape(B, T, -1), type(cache)(new_layers)
+
+
 def init_batch_cache(
     cfg: LlamaConfig,
     b_max: int,
@@ -443,17 +568,16 @@ def init_batch_cache(
     dtype=jnp.float32,
 ) -> list[tuple[jax.Array, jax.Array]]:
     """Slab KV cache for ``b_max`` concurrent decode streams: a list of
-    per-layer ``(keys, values)`` tuples of [b_max, S, Kl, hd] halves (the
-    layered layout with a leading batch axis; i8 slabs quantize per
-    (row, slot, head) exactly like the single-stream i8 cache)."""
+    per-layer FUSED [2, b_max, S, Kl, hd] leaves (keys and values on the
+    leading 2-axis — one coalesced scatter per layer per step; i8 slabs
+    quantize per (row, slot, head) exactly like the single-stream i8
+    cache). ``leaf[0]``/``leaf[1]`` are the (keys, values) halves. The tp
+    backend keeps its own sharded (keys, values)-tuple slab."""
     from distributed_llama_tpu.ops import kv_cache as kvc
 
     kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
     shape = (b_max, cfg.seq_len, kl, cfg.head_size)
-    return [
-        (kvc.init_half(shape, dtype), kvc.init_half(shape, dtype))
-        for _ in range(cfg.n_layers)
-    ]
+    return [kvc.init_fused(shape, dtype) for _ in range(cfg.n_layers)]
 
 
 def init_page_pool(
@@ -489,12 +613,15 @@ def init_cache(
     """Preallocated KV cache [L, 2, S, Kl, hd]
     (reference: KvCacheSlice, src/commands.cpp:97-102).
 
-    ``layered=True`` returns a list of per-layer ``(keys, values)`` tuples
-    of [S, Kl, hd] arrays — the form the unrolled forward needs so in-place
-    cache updates alias per leaf instead of copying the whole cache each
-    step (see attention). ``dtype="i8"`` builds a quantized cache
-    (:class:`distributed_llama_tpu.ops.kv_cache.QuantizedKV` halves — half
-    the HBM of bf16; layered only)."""
+    ``layered=True`` returns a list of per-layer FUSED [2, S, Kl, hd]
+    leaves (``leaf[0]``/``leaf[1]`` = keys/values) — the form the unrolled
+    forward needs so in-place cache updates alias per leaf instead of
+    copying the whole cache each step, with each layer's K/V pair written
+    by ONE coalesced dynamic_update_slice (see attention). ``dtype="i8"``
+    builds a quantized cache
+    (:class:`distributed_llama_tpu.ops.kv_cache.QuantizedKV` with fused
+    [2, S, Kl, hd] data — half the HBM of bf16; layered only). The tp/sp/ep
+    backends build their own sharded ``(keys, values)``-tuple caches."""
     from distributed_llama_tpu.ops import kv_cache as kvc
 
     kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
@@ -502,8 +629,5 @@ def init_cache(
     if kvc.is_quantized_cache_dtype(dtype) and not layered:
         raise ValueError("the i8 KV cache requires the layered cache layout")
     if layered:
-        return [
-            (kvc.init_half(shape, dtype), kvc.init_half(shape, dtype))
-            for _ in range(cfg.n_layers)
-        ]
+        return [kvc.init_fused(shape, dtype) for _ in range(cfg.n_layers)]
     return jnp.zeros((cfg.n_layers, 2) + shape, dtype=dtype)

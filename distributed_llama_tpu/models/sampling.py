@@ -246,6 +246,141 @@ def decode_chunk_batched(
     )
 
 
+# ---------------------------------------------------------------------------
+# Self-speculative decoding (prompt-lookup drafts, Leviathan et al. verify):
+# the host proposes up to k draft tokens from the request's own prompt +
+# output n-grams (engine/speculative.py — no draft model), one verify
+# forward scores [prev, d_1..d_k] in a single weight read, and the
+# accept/reject below runs ON DEVICE so only (n_emit, tokens) — a handful
+# of int32s — cross the host boundary per step.
+# ---------------------------------------------------------------------------
+
+
+def _spec_accept_row(logits, draft, draft_len, key, temperature, topp):
+    """Accept/reject one row's draft against its verify logits.
+
+    ``logits``: [T, vocab] f32 (T = k + 1) — ``logits[i]`` is the model's
+    next-token distribution after consuming feed position ``i``;
+    ``draft``: [k] int32 (entries at or beyond ``draft_len`` are pad);
+    ``temperature``/``topp``: traced scalars. Returns
+    ``(n_emit, tokens [T], new_key)`` where ``tokens[:n_emit]`` are the
+    emitted tokens — ``n_emit - 1`` accepted drafts plus one
+    correction/bonus token drawn from the model's own distribution.
+
+    Greedy (temperature == 0): longest-matching-prefix against the argmax
+    targets — every emitted token IS the plain decode's argmax at its
+    position, so the stream is bit-identical to non-speculative decode.
+
+    Sampled: Leviathan-style rejection sampling. The prompt-lookup draft
+    distribution is the point mass q = δ(draft_i), so position i accepts
+    with probability p_i(draft_i) (p = the post-temperature/top-p filtered
+    softmax — exactly what :func:`_sample_token_dynamic` samples from) and
+    a rejection redraws from the residual norm(max(p - q, 0)) = p with
+    draft_i removed; acceptance never biases the output distribution."""
+    T, vocab = logits.shape
+    k = T - 1
+    greedy_targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [T]
+    # the filtered target distribution, constructed identically to
+    # _sample_token_dynamic (fast-path threshold == full-sort threshold)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    probs = jax.vmap(jax.nn.softmax)(scaled)  # [T, vocab]
+    thresholds = jax.vmap(_topp_threshold, in_axes=(0, None))(probs, topp)
+    use_topp = (topp > 0.0) & (topp < 1.0)
+    filtered = jnp.where(use_topp & (probs < thresholds[:, None]), -jnp.inf, scaled)
+    p = jax.vmap(jax.nn.softmax)(filtered)  # [T, vocab] — renormalized
+
+    split = jax.random.split(key, 2 * T + 1)
+    new_key, u_keys, draw_keys = split[0], split[1 : T + 1], split[T + 1 :]
+
+    i_idx = jnp.arange(k)
+    in_draft = i_idx < draft_len
+    p_draft = p[i_idx, draft]  # [k] acceptance probability per position
+    u = jax.vmap(jax.random.uniform)(u_keys[:k]) if k else jnp.zeros((0,))
+    sampled_ok = u < p_draft
+    greedy_ok = draft == greedy_targets[:k]
+    ok = jnp.where(temperature == 0.0, greedy_ok, sampled_ok) & in_draft
+    acc = jnp.cumprod(ok.astype(jnp.int32)) if k else jnp.zeros((0,), jnp.int32)
+    n_acc = jnp.sum(acc)  # accepted draft prefix length
+
+    # one categorical per position (T is small): the residual draw for a
+    # rejection at i < draft_len, the full draw for the bonus position
+    resid_logits = jnp.where(
+        jnp.arange(vocab)[None, :] == draft[:, None], -jnp.inf, filtered[:k]
+    )
+    resid = (
+        jax.vmap(jax.random.categorical)(draw_keys[:k], resid_logits).astype(jnp.int32)
+        if k
+        else jnp.zeros((0,), jnp.int32)
+    )
+    full = jax.vmap(jax.random.categorical)(draw_keys, filtered).astype(jnp.int32)
+    resid_padded = jnp.concatenate([resid, jnp.zeros((1,), jnp.int32)])
+    rejected = n_acc < draft_len
+    corr_sampled = jnp.where(rejected, resid_padded[n_acc], full[n_acc])
+    corr = jnp.where(temperature == 0.0, greedy_targets[n_acc], corr_sampled)
+
+    t_idx = jnp.arange(T)
+    draft_padded = jnp.concatenate([draft, jnp.zeros((1,), jnp.int32)])
+    tokens = jnp.where(t_idx < n_acc, draft_padded, 0)
+    tokens = jnp.where(t_idx == n_acc, corr, tokens).astype(jnp.int32)
+    return (n_acc + 1).astype(jnp.int32), tokens, new_key
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def spec_verify_step(
+    cfg: LlamaConfig,
+    params,
+    feed: jax.Array,  # int32 [T] — [prev, draft_1..draft_k] (pad beyond draft_len)
+    cache,
+    pos: jax.Array,  # int32 scalar: position of feed[0]
+    draft_len: jax.Array,  # int32 scalar
+    temperature: jax.Array,
+    topp: jax.Array,
+    key: jax.Array,
+):
+    """One single-stream speculative step: verify forward (the ordinary
+    multi-token decode at a position offset — ONE weight read for draft +
+    bonus positions) fused with the on-device accept/reject. Returns
+    ``(out, cache, key)`` with ``out = [n_emit, tokens...]`` int32 [T+1] —
+    the only bytes that visit the host. Cache slots past the accepted
+    prefix hold rejected-draft K/V: stale but unreachable (the next step
+    writes at the advanced position before any query can see them — the
+    same overshoot contract as the chunked decode's rollback)."""
+    logits, cache = llama.forward_tokens(cfg, params, feed, cache, pos)
+    n_emit, tokens, key = _spec_accept_row(
+        logits, feed[1:], draft_len, key, temperature, topp
+    )
+    return jnp.concatenate([n_emit[None], tokens]), cache, key
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def spec_verify_chunk_batched(
+    cfg: LlamaConfig,
+    params,
+    feed: jax.Array,  # int32 [B, T] per-row [prev, drafts...] windows
+    cache,
+    pos: jax.Array,  # int32 [B]
+    active: jax.Array,  # bool [B]
+    draft_len: jax.Array,  # int32 [B]
+    temperature: jax.Array,  # [B]
+    topp: jax.Array,  # [B]
+    keys: jax.Array,  # [B, 2]
+):
+    """One batched speculative step: every joined row's verify window rides
+    ONE weight read (llama.forward_verify_batched) and the per-row
+    accept/reject runs on device. Returns ``(out [B, T+1], cache,
+    new_keys)`` with ``out[b] = [n_emit_b, tokens_b...]`` — rows advance a
+    VARIABLE number of positions per step (the scheduler applies each
+    row's n_emit at fetch time). Inactive rows compute garbage into
+    dropped cache slots, exactly like the plain batched chunk."""
+    logits, cache = llama.forward_verify_batched(
+        cfg, params, feed, cache, pos, active
+    )
+    n_emit, tokens, new_keys = jax.vmap(_spec_accept_row)(
+        logits, feed[:, 1:], draft_len, keys, temperature, topp
+    )
+    return jnp.concatenate([n_emit[:, None], tokens], axis=1), cache, new_keys
+
+
 @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
 def decode_chunk(
     cfg: LlamaConfig,

@@ -45,20 +45,32 @@ def chunk_attention(
     mask = (k_positions[None, :] <= q_positions[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)  # [Tq, K, M]
-    # fully-masked rows (no kv visible in this chunk) produce m=-inf; guard
+    # fully-masked rows (no kv visible in this chunk) keep m = -inf: the
+    # EMPTY partial. merge_partials treats it as an exact identity, which
+    # is what makes a multi-token verify step bit-identical to the plain
+    # decode it replaces (the extra chunks its larger dynamic bound scans
+    # are fully masked for the early queries — a finite sentinel here would
+    # rescale their l/o by exp(m) and perturb the final quotient in ulps).
+    # The exp below still needs a finite reference, hence the local safe_m.
     safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(scores - safe_m[..., None])
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
     o = kvc.mix_einsum(p, v, cdt, prec)
-    return safe_m, l, o
+    return m, l, o
 
 
 def merge_partials(m1, l1, o1, m2, l2, o2):
-    """Merge two online-softmax partials (standard flash-attention merge)."""
+    """Merge two online-softmax partials (standard flash-attention merge).
+
+    An EMPTY partial (m = -inf, l = 0, o = 0 — a fully-masked chunk) merges
+    as an exact identity: its scale factor is forced to 0 and the other
+    side's to exp(0) = 1, so the survivor's l/o pass through bit-unchanged
+    instead of being rescaled by a finite sentinel max."""
     m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
+    safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    a1 = jnp.where(jnp.isfinite(m1), jnp.exp(m1 - safe), 0.0)
+    a2 = jnp.where(jnp.isfinite(m2), jnp.exp(m2 - safe), 0.0)
     l = l1 * a1 + l2 * a2
     o = o1 * a1[..., None] + o2 * a2[..., None]
     return m, l, o
@@ -134,16 +146,68 @@ def batched_decode_attention(
         mask = (k_pos[None, :] <= pos[:, None])[:, None, None, :]
         scores = jnp.where(mask, scores, -jnp.inf)
         ms = jnp.max(scores, axis=-1)
+        # keep m = -inf for fully-masked chunks (the exact-identity empty
+        # partial — see merge_partials); exp still needs a finite reference
         safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
         p = jnp.exp(scores - safe_m[..., None])
         p = jnp.where(mask, p, 0.0)
         ls = jnp.sum(p, axis=-1)
         os_ = kvc.mix_einsum_batched(p, vc, cdt, prec)
-        return merge_partials(m, l, o, safe_m, ls, os_)
+        return merge_partials(m, l, o, ms, ls, os_)
 
     m0 = jnp.full((B, K, M), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, K, M), jnp.float32)
     o0 = jnp.zeros((B, K, M, hd), jnp.float32)
+    m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def batched_verify_attention(
+    qg: jax.Array,  # [B, T, K, M, hd] f32 grouped queries (T = draft k + 1)
+    keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
+    values,
+    pos: jax.Array,  # [B] per-row positions of query t=0 (inactive rows: 0)
+    chunk: int,
+) -> jax.Array:
+    """Blocked causal attention of B independent T-token verify windows
+    (speculative decode): row ``b``'s query ``t`` sits at absolute position
+    ``pos[b] + t`` and sees slots 0..pos[b]+t of its OWN slab row. One
+    fori_loop covers all rows with a shared dynamic chunk bound
+    (max(pos) + T), so slots beyond the longest live window are never
+    read; fully-masked chunks merge as exact identities (empty partials),
+    which keeps each query's output bit-identical to the single-token
+    decode step at the same position. Returns [B, T, K, M, hd] f32.
+    Requires S % chunk == 0 (callers fall back to the full-S einsum)."""
+    B, T, K, M, hd = qg.shape
+    S = keys.shape[1]
+    cdt = kvc.compute_dtype(keys)
+    prec = kvc.einsum_precision(keys)
+    live = jnp.clip(jnp.max(pos) + T, 0, S)
+    n_chunks = jax.lax.div(live + chunk - 1, chunk)
+    q_pos = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+
+    def body(i, carry):
+        m, l, o = carry
+        start = i * chunk
+        kc = kvc.slice_rows_batched(keys, start, chunk, rows=B)
+        vc = kvc.slice_rows_batched(values, start, chunk, rows=B)
+        k_pos = start + jnp.arange(chunk)
+        scores = kvc.scores_einsum_verify(qg.astype(cdt), kc, prec) / jnp.sqrt(
+            jnp.float32(hd)
+        )  # [B, T, K, M, chunk]
+        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, :, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        ms = jnp.max(scores, axis=-1)
+        safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(mask, p, 0.0)
+        ls = jnp.sum(p, axis=-1)
+        os_ = kvc.mix_einsum_verify(p, vc, cdt, prec)
+        return merge_partials(m, l, o, ms, ls, os_)
+
+    m0 = jnp.full((B, T, K, M), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, T, K, M), jnp.float32)
+    o0 = jnp.zeros((B, T, K, M, hd), jnp.float32)
     m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
     return o / jnp.maximum(l, 1e-30)[..., None]
 

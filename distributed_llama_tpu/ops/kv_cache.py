@@ -39,7 +39,12 @@ I8_SENTINELS = ("i8", "int8")
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedKV:
-    """One cache half (keys or values): int8 rows + per-(slot, head) scales."""
+    """One cache half (keys or values): int8 rows + per-(slot, head) scales.
+
+    Also the container of a FUSED per-layer cache (keys and values stacked
+    on a leading 2-axis, see the fused-layout note below): indexing slices
+    both leaves, so ``fused[0]``/``fused[1]`` are the (keys, values) halves
+    exactly like a ``(keys, values)`` tuple's elements."""
 
     data: jax.Array  # int8 [S, K, hd]
     scales: jax.Array  # f32 [S, K, 1]
@@ -51,6 +56,12 @@ class QuantizedKV:
     @property
     def dtype(self):
         return self.data.dtype
+
+    def __getitem__(self, idx):
+        return QuantizedKV(self.data[idx], self.scales[idx])
+
+    def __iter__(self):  # unpack a fused leaf like a (keys, values) tuple
+        return iter((self[0], self[1]))
 
     def tree_flatten(self):
         return (self.data, self.scales), None
@@ -171,6 +182,19 @@ def update_row_batched(half, rows: jax.Array, slot: jax.Array):
     return half.at[b_idx, slot].set(rows.astype(half.dtype), mode="drop")
 
 
+def scatter_verify_rows(half, b_idx: jax.Array, slots: jax.Array, rows: jax.Array):
+    """Per-half multi-token verify scatter (the tuple-slab counterpart of
+    :func:`fused_update_verify_batched`): ``rows`` [B, T, K, hd] land at
+    ``half[b, slots[b, t]]``; out-of-bounds slots drop."""
+    if isinstance(half, QuantizedKV):
+        q, s = quantize_rows(rows)
+        return QuantizedKV(
+            half.data.at[b_idx, slots].set(q, mode="drop"),
+            half.scales.at[b_idx, slots].set(s, mode="drop"),
+        )
+    return half.at[b_idx, slots].set(rows.astype(half.dtype), mode="drop")
+
+
 def slice_rows_batched(half, start, n: int, rows: int | None = None):
     """Read ``n`` slots [start, start+n) of the first ``rows`` slab rows
     (the batched blocked-attention chunk read). ``start`` may be traced;
@@ -278,6 +302,173 @@ def publish_row_pages(pool_half, slab_half, row, src_page, page_ids, page: int):
     vals = slab_half[row, slots]
     return pool_half.at[page_ids].set(
         vals.reshape((n, page) + vals.shape[1:]), mode="drop"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused (coalesced) per-layer cache: keys and values stacked on a LEADING
+# 2-axis — [2, S, K, hd] single-stream, [2, B, S, K, hd] slab — so each
+# layer's K/V write is ONE dynamic_update_slice / scatter instead of the
+# historical (keys, values) pair. The leading axis is fully covered by
+# every write (index 0, extent 2), so XLA aliases the donated leaf in
+# place exactly like the tuple halves did; reads are static leading-index
+# slices (``fused[0]``/``fused[1]``) — contiguous views, no copy. PERF.md
+# names the per-layer update pair on the decode critical path; halving the
+# op count is the point. i8 fuses the same way (QuantizedKV with
+# [2, ...] data+scales: 2 updates per layer instead of 4). The tensor/
+# sequence/expert-parallel backends keep tuple halves (their cache
+# PartitionSpecs shard the unfused rank), so every update helper here
+# keeps its tuple form too.
+# ---------------------------------------------------------------------------
+
+
+def init_fused(shape, dtype, zeros=jnp.zeros):
+    """One fused per-layer cache leaf: keys+values as [2, *shape]."""
+    if is_quantized_cache_dtype(dtype):
+        return QuantizedKV(
+            zeros((2,) + shape, jnp.int8),
+            zeros((2,) + shape[:-1] + (1,), jnp.float32),
+        )
+    return zeros((2,) + shape, dtype)
+
+
+def is_fused_leaf(cache_l) -> bool:
+    """Fused leaves are a single array/QuantizedKV; tuple = split halves."""
+    return not isinstance(cache_l, (tuple, list))
+
+
+def fused_update_rows(leaf, k_rows: jax.Array, v_rows: jax.Array, pos):
+    """The coalesced write of :func:`update_rows` pairs: T tokens' keys AND
+    values land at slots pos..pos+T-1 of a fused leaf in one
+    dynamic_update_slice (two — data+scales — for i8)."""
+    if isinstance(leaf, QuantizedKV):
+        kq, ks = quantize_rows(k_rows)
+        vq, vs = quantize_rows(v_rows)
+        return QuantizedKV(
+            jax.lax.dynamic_update_slice(leaf.data, jnp.stack([kq, vq]), (0, pos, 0, 0)),
+            jax.lax.dynamic_update_slice(leaf.scales, jnp.stack([ks, vs]), (0, pos, 0, 0)),
+        )
+    stacked = jnp.stack([k_rows, v_rows]).astype(leaf.dtype)
+    return jax.lax.dynamic_update_slice(leaf, stacked, (0, pos, 0, 0))
+
+
+def fused_update_row_batched(leaf, k_rows: jax.Array, v_rows: jax.Array, slot: jax.Array):
+    """Coalesced batched decode write: row ``b``'s key AND value land at
+    slab slot ``slot[b]`` in one scatter (slot >= S drops, retiring rows
+    exactly like :func:`update_row_batched`)."""
+    b_idx = jnp.arange(k_rows.shape[0])
+    if isinstance(leaf, QuantizedKV):
+        kq, ks = quantize_rows(k_rows)
+        vq, vs = quantize_rows(v_rows)
+        return QuantizedKV(
+            leaf.data.at[:, b_idx, slot].set(jnp.stack([kq, vq]), mode="drop"),
+            leaf.scales.at[:, b_idx, slot].set(jnp.stack([ks, vs]), mode="drop"),
+        )
+    stacked = jnp.stack([k_rows, v_rows]).astype(leaf.dtype)
+    return leaf.at[:, b_idx, slot].set(stacked, mode="drop")
+
+
+def fused_update_verify_batched(leaf, k_rows: jax.Array, v_rows: jax.Array, slots: jax.Array):
+    """Coalesced multi-token verify write (speculative decode): row ``b``'s
+    T keys AND values land at its per-row slots ``slots[b, t]`` in ONE
+    scatter per layer. ``k_rows``/``v_rows``: [B, T, K, hd]; out-of-bounds
+    slots drop (inactive rows and context-limit clamps write nothing)."""
+    b_idx = jnp.arange(k_rows.shape[0])[:, None]
+    if isinstance(leaf, QuantizedKV):
+        kq, ks = quantize_rows(k_rows)
+        vq, vs = quantize_rows(v_rows)
+        return QuantizedKV(
+            leaf.data.at[:, b_idx, slots].set(jnp.stack([kq, vq]), mode="drop"),
+            leaf.scales.at[:, b_idx, slots].set(jnp.stack([ks, vs]), mode="drop"),
+        )
+    stacked = jnp.stack([k_rows, v_rows]).astype(leaf.dtype)
+    return leaf.at[:, b_idx, slots].set(stacked, mode="drop")
+
+
+def fused_take_row(leaf, row):
+    """Extract slab row ``row`` of a fused [2, B, S, K, hd] leaf as a fused
+    single-stream [2, S, K, hd] leaf (the slab prefill's row view)."""
+    if isinstance(leaf, QuantizedKV):
+        _, B, S, K, hd = leaf.data.shape
+        return QuantizedKV(
+            jax.lax.dynamic_slice(leaf.data, (0, row, 0, 0, 0), (2, 1, S, K, hd))[:, 0],
+            jax.lax.dynamic_slice(leaf.scales, (0, row, 0, 0, 0), (2, 1, S, K, 1))[:, 0],
+        )
+    _, B, S, K, hd = leaf.shape
+    return jax.lax.dynamic_slice(leaf, (0, row, 0, 0, 0), (2, 1, S, K, hd))[:, 0]
+
+
+def fused_put_row(slab_leaf, row_leaf, row):
+    """Write a fused single-stream row back into fused slab row ``row`` —
+    one dynamic_update_slice covers both halves."""
+    if isinstance(slab_leaf, QuantizedKV):
+        return QuantizedKV(
+            jax.lax.dynamic_update_slice(
+                slab_leaf.data, row_leaf.data[:, None], (0, row, 0, 0, 0)
+            ),
+            jax.lax.dynamic_update_slice(
+                slab_leaf.scales, row_leaf.scales[:, None], (0, row, 0, 0, 0)
+            ),
+        )
+    return jax.lax.dynamic_update_slice(slab_leaf, row_leaf[:, None], (0, row, 0, 0, 0))
+
+
+def fused_gather_pages(leaf, pool_k, pool_v, page_ids, dest_page, row, page: int):
+    """The fused-slab form of :func:`gather_pages_to_row`: both pool halves'
+    pages land in slab row ``row`` with one scatter (per-slot drop at
+    ceil(S/page), same pad contract)."""
+    p_idx = jnp.arange(page)
+    slots = (dest_page[:, None] * page + p_idx[None, :]).reshape(-1)
+    if isinstance(leaf, QuantizedKV):
+        vals = jnp.stack([pool_k.data[page_ids], pool_v.data[page_ids]])
+        scal = jnp.stack([pool_k.scales[page_ids], pool_v.scales[page_ids]])
+        return QuantizedKV(
+            leaf.data.at[:, row, slots].set(
+                vals.reshape((2, -1) + vals.shape[3:]), mode="drop"
+            ),
+            leaf.scales.at[:, row, slots].set(
+                scal.reshape((2, -1) + scal.shape[3:]), mode="drop"
+            ),
+        )
+    vals = jnp.stack([pool_k[page_ids], pool_v[page_ids]])
+    return leaf.at[:, row, slots].set(
+        vals.reshape((2, -1) + vals.shape[3:]), mode="drop"
+    )
+
+
+def scores_einsum_verify(qg: jax.Array, keys, prec) -> jax.Array:
+    """Batched multi-token verify scores: scores[b,t,k,m,s] =
+    q[b,t,k,m,:] . key_row[b,s,k,:] (same i8 scale-folding contract as
+    :func:`scores_einsum_batched`, with a T axis riding along)."""
+    if isinstance(keys, QuantizedKV):
+        raw = jnp.einsum(
+            "btkmh,bskh->btkms",
+            qg,
+            keys.data.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return raw * jnp.transpose(keys.scales[..., 0], (0, 2, 1))[:, None, :, None, :]
+    return jnp.einsum(
+        "btkmh,bskh->btkms", qg, keys, precision=prec,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def mix_einsum_verify(weights: jax.Array, values, cdt, prec) -> jax.Array:
+    """Batched multi-token verify value mix: att[b,t,k,m,h] =
+    sum_s w[b,t,k,m,s] * v[b,s,k,h]; the i8 scale folds into the weights
+    BEFORE the mix (the value read stays int8)."""
+    if isinstance(values, QuantizedKV):
+        wv = weights * jnp.transpose(values.scales[..., 0], (0, 2, 1))[:, None, :, None, :]
+        return jnp.einsum(
+            "btkms,bskh->btkmh",
+            wv.astype(cdt),
+            values.data.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "btkms,bskh->btkmh", weights.astype(cdt), values, precision=prec,
+        preferred_element_type=jnp.float32,
     )
 
 

@@ -193,6 +193,11 @@ class ApiState:
                     # admission_queue=0 bug class)
                     page_size=getattr(args, "kv_page_size", 64),
                     prefill_chunk=getattr(args, "prefill_chunk", 256),
+                    # self-speculative decode (ISSUE 6): batched verify
+                    # steps with prompt-lookup drafts; 0 (the default)
+                    # keeps the proven chunked dispatch
+                    spec_draft=getattr(args, "spec_draft", 0),
+                    spec_ngram=getattr(args, "spec_ngram", 3),
                 )
             except ValueError as e:  # backend without a batched path (sp/ep)
                 print(f"⚠️ batch decode disabled: {e}")
@@ -458,6 +463,12 @@ class ApiState:
                     first_dev, on_token, params["temperature"], self.args.topp,
                     seed=seed, chunk=getattr(self.args, "decode_chunk", 32),
                     limit=max_pos, key=chunk_key, first_prev=prompt_tokens[-1],
+                    # self-speculative decode (--spec-draft k): prompt-lookup
+                    # drafts over this request's prompt + output, verified
+                    # k at a time in one weight read; 0 = plain chunked path
+                    spec_draft=getattr(self.args, "spec_draft", 0),
+                    spec_ngram=getattr(self.args, "spec_ngram", 3),
+                    prompt_tokens=prompt_tokens,
                 )
         else:
             if max_new > 0:

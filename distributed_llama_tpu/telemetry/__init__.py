@@ -266,6 +266,31 @@ class EngineInstruments:
             "dllama_watchdog_stalls_total",
             "Hung batched chunks the stall watchdog failed cleanly",
         )
+        # speculative decoding (--spec-draft): draft volume, acceptance and
+        # per-step advance — the health read is accepted/draft (the
+        # prompt-lookup hit rate) and the advance histogram's mass above 1
+        # (how many weight reads the drafts actually saved)
+        self.spec_draft_tokens = counter(
+            "dllama_spec_draft_tokens_total",
+            "Prompt-lookup draft tokens proposed to speculative verify steps",
+        )
+        self.spec_accepted_tokens = counter(
+            "dllama_spec_accepted_tokens_total",
+            "Draft tokens accepted by speculative verify (excludes the "
+            "per-step bonus/correction token)",
+        )
+        self.spec_acceptance = histogram(
+            "dllama_spec_acceptance_ratio",
+            "Accepted/drafted ratio per speculative verify step that "
+            "proposed at least one draft token (0..1)",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
+        self.spec_step_advance = histogram(
+            "dllama_spec_step_advance_tokens",
+            "Positions advanced per row per speculative verify step "
+            "(accepted drafts + 1; plain decode is identically 1)",
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0),
+        )
 
 
 class PrefixCacheInstruments:

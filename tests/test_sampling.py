@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_llama_tpu.engine import InferenceEngine
 from distributed_llama_tpu.models.sampling import sample_token
@@ -35,6 +36,56 @@ class TestSampleToken:
             int(sample_token(logits, jax.random.PRNGKey(s), 1.0, 0.0)) for s in range(50)
         }
         assert seen == {0, 1, 2, 3}
+
+
+class TestToppThresholdBoundary:
+    """The nucleus-threshold fast path (top-k of TOPP_FAST_K) must agree
+    with the full-vocab sort exactly when the nucleus ends AT the fast-path
+    boundary — the largest nucleus the fast path may legally serve."""
+
+    def _full_sort_threshold(self, probs, topp):
+        s = np.sort(probs)[::-1]
+        cum = np.cumsum(s)
+        cutoff = int(np.sum(cum - s < topp))
+        return s[max(cutoff - 1, 0)]
+
+    def _boundary_probs(self):
+        """Dyadic probabilities (exact in f32, cumsums included): the top
+        TOPP_FAST_K entries hold 1/256 each (cumulative exactly 0.5), the
+        256 tail entries 1/512 each — no rounding anywhere, so the nucleus
+        boundary is bit-exact, not a float knife-edge."""
+        from distributed_llama_tpu.models.sampling import TOPP_FAST_K
+
+        probs = np.full(TOPP_FAST_K + 256, 1.0 / 512.0, np.float32)
+        probs[:TOPP_FAST_K] = np.float32(0.5) / TOPP_FAST_K  # 1/256
+        return probs
+
+    def test_nucleus_ends_exactly_at_fast_k(self):
+        from distributed_llama_tpu.models.sampling import (
+            TOPP_FAST_K,
+            _topp_threshold,
+        )
+
+        probs = self._boundary_probs()
+        # topp = 0.5 = the cumulative mass of exactly the top TOPP_FAST_K
+        # entries: the largest nucleus the fast path may legally serve —
+        # cum_k[-1] >= topp holds with equality and the threshold must be
+        # the boundary element itself
+        got = float(_topp_threshold(jnp.asarray(probs), jnp.float32(0.5)))
+        want = self._full_sort_threshold(probs, np.float32(0.5))
+        assert got == float(want) == float(np.float32(0.5) / TOPP_FAST_K)
+
+    def test_nucleus_one_past_fast_k_takes_full_sort(self):
+        from distributed_llama_tpu.models.sampling import _topp_threshold
+
+        probs = self._boundary_probs()
+        # one half-tail-element of extra mass: cum_k[-1] = 0.5 < topp, so
+        # the lax.cond must route to the full sort — whose answer at the
+        # seam (the first tail element) must match the numpy reference
+        topp = np.float32(0.5 + 1.0 / 1024.0)
+        got = float(_topp_threshold(jnp.asarray(probs), jnp.float32(topp)))
+        want = self._full_sort_threshold(probs, topp)
+        assert got == float(want) == float(np.float32(1.0 / 512.0))
 
 
 class TestDecodeLoop:
