@@ -904,8 +904,9 @@ def run_prefix_cache(chaos: bool = False) -> dict:
         return rng.randint(1, spec.vocab_size, 8).tolist()
 
     # warm every compiled shape untimed: the cold bucket-128 prefill, the
-    # miss-side publish, and (second same-prefix request) the page gather +
-    # the bucket-8 suffix prefill
+    # miss-side publish, and (second same-prefix request) the paged
+    # suffix-prefill program reading the matched pages through the row's
+    # page table + the bucket-8 suffix shape
     warm_prefix = rng.randint(1, spec.vocab_size, 64).tolist()
     ttft_ms(streams[0], warm_prefix + tail(0), 0)
     ttft_ms(streams[0], warm_prefix + tail(1), 0)
@@ -927,16 +928,39 @@ def run_prefix_cache(chaos: bool = False) -> dict:
     # that reuse it with distinct tails — the chat system-prompt workload
     ttft_ms(streams[0], shared_prefix + tail(100), 0)
     hits_before = ctr("dllama_prefix_cache_hits_total")
+    saved_before = ctr("dllama_prefix_cache_copy_bytes_saved_total")
+    spans_before = len(telemetry.TRACER.events())
     hit_runs = []
     for r in range(3):
         with telemetry.trace_span("bench_prefix_hit", rep=r):
             hit_runs.append(ttft_ms(streams[1], shared_prefix + tail(200 + r), r))
     ttft_hit = sorted(hit_runs)[1]
-    assert ctr("dllama_prefix_cache_hits_total") - hits_before >= 3, (
+    hits_measured = ctr("dllama_prefix_cache_hits_total") - hits_before
+    assert hits_measured >= 3, (
         "repeated-prefix requests did not hit the prefix cache"
     )
+    # measured, not assumed: a gather program on the hit path would record a
+    # *gather* span (the PR 4 copy design's prefix_gather); observing none
+    # across the hit loop is what makes the reported per-hit traffic zero
+    hit_gather_spans = sum(
+        1
+        for ev in telemetry.TRACER.events()[spans_before:]
+        if "gather" in ev.name
+    )
+    if hit_gather_spans:
+        saved = ctr("dllama_prefix_cache_copy_bytes_saved_total") - saved_before
+        raise AssertionError(
+            f"zero-copy regression: {hit_gather_spans} gather dispatches "
+            f"across {int(hits_measured)} hits (~{int(saved / hits_measured)} "
+            "bytes/hit of copy traffic the page-table read was supposed to "
+            "eliminate)"
+        )
+    gathered_bytes_per_hit = 0  # the measured zero: no gather spans above
     speedup = ttft_cold / max(ttft_hit, 1e-9)
 
+    # tree + alias invariants after the measured workload (no page freed
+    # while a live row's table references it)
+    sched.check_prefix()
     detail = {
         "ttft_cold_ms": round(bench_metric("prefix_ttft_cold_ms", ttft_cold, "ms"), 2),
         "ttft_hit_ms": round(bench_metric("prefix_ttft_hit_ms", ttft_hit, "ms"), 2),
@@ -944,6 +968,22 @@ def run_prefix_cache(chaos: bool = False) -> dict:
         "prefix_cache_misses": int(ctr("dllama_prefix_cache_misses_total")),
         "prefix_cache_evictions": int(ctr("dllama_prefix_cache_evictions_total")),
         "prefix_cache_pages": int(reg.gauge("dllama_prefix_cache_pages").value),
+        # zero-copy pool accounting: the pool IS the only resident copy of
+        # cached prefixes; per-hit gather traffic is measured above (span
+        # count over the hit loop) and the saved counter is the copy
+        # traffic the old design would have paid for the same hits
+        "pool_capacity_pages": sched._prefix.capacity,
+        "pool_occupancy": round(
+            sched._prefix.pages_in_use() / sched._prefix.capacity, 3
+        ),
+        "pool_bytes": int(reg.gauge("dllama_prefix_cache_bytes").value),
+        "pool_pinned_pages": int(
+            reg.gauge("dllama_prefix_cache_pinned_pages").value
+        ),
+        "gathered_bytes_per_hit": gathered_bytes_per_hit,
+        "copy_bytes_saved": int(
+            ctr("dllama_prefix_cache_copy_bytes_saved_total")
+        ),
         "page_size": page,
         "workload": "64-token shared prefix + distinct 8-token tails "
         "(TTFT = prefill_device dispatch -> first token on host, medians "
@@ -954,9 +994,11 @@ def run_prefix_cache(chaos: bool = False) -> dict:
     }
 
     if chaos:
-        # quarantine a row that took a prefix hit mid-decode; the tree must
-        # keep every page (rows hold COPIES of tree pages, never the pages
-        # themselves — docs/PERF.md "Quarantine safety")
+        # quarantine a row that took a prefix hit mid-decode; under
+        # zero-copy aliasing the victim's attention reads tree pages
+        # through its page table, so quarantine must release ITS pins
+        # while the pages stay mapped (and pinned) for every other live
+        # reader — docs/PERF.md "Zero-copy paged attention"
         def greedy(stream, tokens, n=16):
             stream.reset()
             first, key = stream.prefill_device(tokens, 0.0, 0.9, 0)
@@ -993,7 +1035,13 @@ def run_prefix_cache(chaos: bool = False) -> dict:
         assert pages_after == pages_before, (
             f"quarantine freed tree pages: {pages_before} -> {pages_after}"
         )
-        sched._prefix.check()  # no page aliased or leaked
+        # zero-copy contract: the quarantined row's page pins released (the
+        # pages stay in the tree for other readers, but nothing pins them
+        # on the dead row's behalf) and the alias invariants hold
+        assert not streams[1]._alias_ids and streams[1].matched_len == 0, (
+            "quarantine left the victim row's page pins held"
+        )
+        sched.check_prefix()  # no page aliased, leaked, or freed-while-read
         hits_pre = ctr("dllama_prefix_cache_hits_total")
         replay = greedy(streams[0], victim_prompt)
         assert ctr("dllama_prefix_cache_hits_total") > hits_pre, (

@@ -36,13 +36,20 @@ Architecture
   scheduler lock released between them, so other rows' decode chunks
   interleave with a long prefill (Sarathi-style; ISSUE 4 satellite).
 * With ``prefix_cache=True`` the scheduler also owns a page pool
-  (``llama.init_page_pool``) and a radix tree over token blocks
-  (``engine/prefix_cache.py``): an admission prefill (row position 0)
-  copies its matched prefix pages out of the tree and prefills only the
-  unmatched suffix; completed full pages are published back. Copy
-  semantics keep rows and tree pages disjoint, so quarantine/reset of a
-  row can never free shared pages — and a prefix-hit stream is
-  bit-identical to the cold prefill (tests/test_prefix_cache.py).
+  (``llama.init_page_pool`` single-chip, the tp engine's sharded pool on
+  multi-chip) and a radix tree over token blocks (``engine/
+  prefix_cache.py``): an admission prefill (row position 0) binds its
+  matched prefix pages to the row as ``(page_ids, matched_len)`` — the
+  row's decode/verify/prefill attention then reads those positions
+  **zero-copy through its page table over the pool** (ops.attention paged
+  variants) while only the unmatched suffix prefills into the slab row;
+  completed full pages are published back (the only copy left in the
+  system). Because rows alias tree pages, the matched chain stays
+  ref-pinned for the ROW'S LIFETIME (released at reset/quarantine/
+  rollback-truncation), so eviction can never recycle a page a live row
+  is attending over — chaos-enforced, and a prefix-hit stream is
+  bit-identical to the cold prefill (tests/test_prefix_cache.py,
+  tests/test_paged_attention.py).
 * Per-row PRNG keys, temperatures and top-p thread through the batched
   program, so a row's token stream is bit-identical to the single-stream
   chunked decode for the same per-row key (tests/test_batch_decode.py) and
@@ -91,20 +98,6 @@ def _page_bucket(n: int) -> int:
     return next_pow2(n)
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
-def _gather_pages(page: int, slab, pool, page_ids, dest_page, row):
-    """Copy pool pages ``page_ids`` into slab row ``row`` at page slots
-    ``dest_page`` across every layer (the admission-time prefix bind:
-    correctness-first copy — the row gets its OWN bytes, so nothing it does
-    later can touch the immutable tree pages). The donated slab aliases in
-    place; the pool is read-only here. The fused slab leaf takes both pool
-    halves' pages in one coalesced scatter per layer."""
-    return [
-        kvc.fused_gather_pages(leaf, pk, pv, page_ids, dest_page, row, page)
-        for leaf, (pk, pv) in zip(slab, pool)
-    ]
-
-
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _publish_pages(page: int, slab, pool, page_ids, src_page, row):
     """Copy slab row ``row``'s page slots ``src_page`` into pool pages
@@ -130,6 +123,27 @@ def _slab_prefill_single(cfg: LlamaConfig, params, tokens, slab, row, pos, n_rea
     row_cache = [kvc.fused_take_row(leaf, row) for leaf in slab]
     logits, new_rows = llama.forward_tokens(
         cfg, params, tokens, row_cache, pos, n_real=n_real
+    )
+    new_slab = [
+        kvc.fused_put_row(leaf, new_leaf, row)
+        for leaf, new_leaf in zip(slab, new_rows)
+    ]
+    return logits, new_slab
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def _slab_prefill_single_paged(
+    cfg: LlamaConfig, params, tokens, slab, pool, row, pos, n_real, table, matched
+):
+    """:func:`_slab_prefill_single` with zero-copy prefix aliasing: the
+    row's attention reads positions below ``matched`` from the page pool
+    through ``table`` (the admission-time suffix prefill and any later
+    continuation prefill on an aliased row). The pool is read-only — only
+    the slab is donated."""
+    row_cache = [kvc.fused_take_row(leaf, row) for leaf in slab]
+    logits, new_rows = llama.forward_tokens(
+        cfg, params, tokens, row_cache, pos, n_real=n_real,
+        paged=(pool, table, matched),
     )
     new_slab = [
         kvc.fused_put_row(leaf, new_leaf, row)
@@ -172,6 +186,16 @@ class BatchStream:
         # False skips BOTH the admission match and the post-prefill publish
         # for this row (ISSUE 4); serving restores True between requests
         self.prefix_cache_enabled = True
+        # zero-copy prefix aliasing (ISSUE 7): the admission match binds the
+        # matched radix chain to this row — attention reads positions below
+        # ``matched_len`` THROUGH ``_alias_ids`` (the row's page table) over
+        # the shared pool instead of slab copies. ``_alias_chain`` holds the
+        # ref-pinned PageNodes for the row's lifetime; the scheduler
+        # releases them at reset/quarantine and truncates them on rollback
+        # below ``matched_len`` (all under its cond lock)
+        self._alias_chain: list = []
+        self._alias_ids: list[int] = []
+        self.matched_len = 0
         # speculative decode (scheduler spec mode): this row's host-side
         # prompt-lookup corpus (prompt + emitted tokens, extended at chunk
         # delivery) and its lazily-built drafter. ``_spec_on`` False rides
@@ -201,6 +225,10 @@ class BatchStream:
 
     def reset(self) -> None:
         self.scheduler._leave(self)
+        # release the row's zero-copy page pins: the next occupant matches
+        # its own chain, and the old pages become evictable once no other
+        # row aliases them
+        self.scheduler._release_row_pins(self)
         self.pos = 0
         # same cadence no-op contract as EngineStream.reset(): clearing this
         # stream's stats shrinks the engine-wide token sum, so the transfer
@@ -224,10 +252,17 @@ class BatchStream:
         Slab slots beyond ``pos`` — including any written by an in-flight
         speculative chunk — are stale but unreachable: attention masks
         s <= pos and the next prefill overwrites them before the position
-        pointer crosses."""
+        pointer crosses. A rollback BELOW the aliased prefix truncates the
+        alias to ``pos`` (the rolled-back-onto tokens are a shared prefix,
+        so the pool bytes below ``pos`` stay valid) and releases the pins
+        of pages the shortened table no longer reaches — the next prefill
+        writes the slab at ``pos`` and must be read from the slab, not the
+        pool."""
         if not 0 <= pos <= self.pos:
             raise ValueError(f"cannot rollback to {pos} from {self.pos}")
         self.pos = pos
+        if self.matched_len > pos:
+            self.scheduler._truncate_alias(self, pos)
 
     # ------------------------------------------------------------------
     # Prefill (per-request, on this stream's slab row)
@@ -465,9 +500,10 @@ class BatchScheduler:
         # interleave instead of stalling behind the whole prompt. 0 = one
         # monolithic dispatch (the pre-ISSUE-4 behavior).
         self.prefill_chunk = max(0, int(prefill_chunk or 0))
-        # radix-tree prefix cache over pool pages (ISSUE 4 tentpole): an
-        # admission prefill reuses published KV pages for its matched
-        # prompt prefix and prefills only the unmatched suffix
+        # radix-tree prefix cache over pool pages (ISSUE 4 tentpole, ISSUE 7
+        # zero-copy): an admission prefill binds published KV pages to the
+        # row's page table (attention reads them straight out of the pool)
+        # and prefills only the unmatched suffix
         self._prefix = None
         self._pool = None
         if prefix_cache:
@@ -477,18 +513,17 @@ class BatchScheduler:
             # the server's backend-fallback handler and silently cost the
             # whole one-weight-read-per-step serving path)
             page_ok = 1 <= page_size <= engine.cfg.seq_len
+            slab_pages = n_rows * -(-engine.cfg.seq_len // page_size) if page_ok else 0
             if kv_pages is None and page_ok:
-                # default HBM budget: one slab's worth of pages (the pool
-                # roughly doubles KV memory; size it explicitly with
-                # --kv-pages on deployments near the HBM limit)
-                kv_pages = max(1, n_rows * (engine.cfg.seq_len // page_size))
-            if tp_engine is not None:
-                print(
-                    "⚠️ prefix cache disabled: the page pool is single-chip "
-                    "only for now (zero-copy sharded pages are the "
-                    "documented follow-up, docs/PERF.md)"
+                # default HBM budget: with zero-copy aliasing the pool is
+                # the PRIMARY store of cached prefixes (rows hold no
+                # duplicates), so size it to hold every row's worth of
+                # prefix plus headroom for prefixes outliving their rows
+                # (--parallel x ceil(seq_len/page) + 25%, at least one row)
+                kv_pages = slab_pages + max(
+                    slab_pages // 4, -(-engine.cfg.seq_len // page_size)
                 )
-            elif not page_ok:
+            if not page_ok:
                 print(
                     f"⚠️ prefix cache disabled: page size {page_size} must "
                     f"be in [1, seq_len {engine.cfg.seq_len}]"
@@ -496,12 +531,38 @@ class BatchScheduler:
             elif kv_pages < 1:
                 print("⚠️ prefix cache disabled: --kv-pages 0")
             else:
+                if kv_pages < slab_pages:
+                    print(
+                        f"⚠️ --kv-pages {kv_pages} is smaller than one "
+                        f"slab's worth ({slab_pages} pages for {n_rows} "
+                        f"rows x seq_len {engine.cfg.seq_len}): the pool is "
+                        "the primary prefix store under zero-copy paged "
+                        "attention, so concurrent long prompts will "
+                        "contend for pages (pinned-page soft failures)"
+                    )
                 from distributed_llama_tpu.engine.prefix_cache import PrefixCache
 
-                self._prefix = PrefixCache(kv_pages, page_size)
-                self._pool = llama.init_page_pool(
-                    engine.cfg, kv_pages, page_size, dtype=engine.cache_dtype
+                self._prefix = PrefixCache(
+                    kv_pages, page_size,
+                    page_bytes=llama.page_pool_bytes(
+                        engine.cfg, page_size, engine.cache_dtype
+                    ),
                 )
+                if tp_engine is None:
+                    self._pool = llama.init_page_pool(
+                        engine.cfg, kv_pages, page_size, dtype=engine.cache_dtype
+                    )
+                else:
+                    # the sharded pool (per-shard [P, page, K/tp, hd]
+                    # halves): PR 4 deferred multi-chip; the zero-copy read
+                    # made it a plain per-shard local program
+                    self._pool = tp_engine.init_page_pool(
+                        kv_pages, page_size, dtype=engine.cache_dtype
+                    )
+                # static per-row page-table width: every table the
+                # scheduler builds covers ceil(S/page) entries (one
+                # compiled paged program per bucket/chunk shape)
+                self._n_table = -(-engine.cfg.seq_len // page_size)
         # self-speculative decode (ISSUE 6): spec_draft > 0 turns every
         # batched dispatch into a VERIFY step — per-row prompt-lookup
         # drafts scored in one weight read, rows advancing a variable
@@ -606,6 +667,7 @@ class BatchScheduler:
                             "batched chunk fetch exceeded the "
                             f"{self.stall_timeout_s:.1f}s stall timeout"
                         )
+                        self._release_pins_locked(s)
                 tel.watchdog_stalls.inc()
                 self._cond.notify_all()
 
@@ -626,11 +688,13 @@ class BatchScheduler:
     def _prefill_row(self, stream: BatchStream, tokens: np.ndarray):
         """Prefill ``tokens`` into ``stream``'s slab row. On an ADMISSION
         prefill (row position 0, prefix cache active, request not opted
-        out) the radix tree is consulted first: matched prefix pages are
-        gathered into the row and only the unmatched suffix is dispatched;
-        the completed prefill's full pages are then published back into the
-        tree. Returns ``(logits, last)`` — the final dispatch's device
-        logits and the index of the last REAL token's row within them."""
+        out) the radix tree is consulted first: the matched chain is BOUND
+        to the row as its zero-copy page table (no bytes move) and only
+        the unmatched suffix is dispatched — its attention reads the
+        matched prefix straight out of the pool; the completed prefill's
+        full pages are then published back into the tree. Returns
+        ``(logits, last)`` — the final dispatch's device logits and the
+        index of the last REAL token's row within them."""
         engine = self.engine
         n = tokens.shape[0]
         if n == 0:
@@ -647,17 +711,18 @@ class BatchScheduler:
         chain: list = []
         suffix = tokens
         if admission:
-            chain = self._gather_matched(stream, tokens)
+            chain = self._match_alias(stream, tokens)
             if chain:
                 suffix = tokens[len(chain) * self._prefix.page :]
         try:
             logits, last = self._dispatch_prefill_chunks(stream, suffix)
         except BaseException:
-            # a failed suffix prefill must not leave the matched chain
-            # pinned against eviction forever
+            # a failed suffix prefill fails the request: unwind the alias
+            # bind (release the chain pins, reset the position) so the
+            # row is clean for its next occupant and the pages evictable
             if chain:
-                with self._cond:
-                    self._prefix.release(chain)
+                self._release_row_pins(stream)
+                stream.pos = 0
             raise
         if admission:
             self._publish_row(stream, tokens, chain)
@@ -696,7 +761,25 @@ class BatchScheduler:
             padded = np.zeros(bucket, dtype=np.int32)
             padded[:c] = tokens[off : off + c]
             with self._cond:
-                if engine._tp_engine is None:
+                if self._pool is not None:
+                    # pool-enabled scheduler: every prefill runs the paged
+                    # program — an unaliased row dispatches with matched 0
+                    # (pure slab reads, byte-identical to the plain one),
+                    # so one compiled program serves hits and misses
+                    table, matched = self._alias_row_arrays_locked(stream)
+                    if engine._tp_engine is None:
+                        logits, self._slab = _slab_prefill_single_paged(
+                            engine.cfg, engine.params, jnp.asarray(padded),
+                            self._slab, self._pool, jnp.int32(stream.row),
+                            jnp.int32(stream.pos), jnp.int32(c), table, matched,
+                        )
+                    else:
+                        logits, self._slab = engine._tp_engine.slab_forward_paged(
+                            engine.params, jnp.asarray(padded), self._slab,
+                            self._pool, stream.row, stream.pos, c, table,
+                            matched,
+                        )
+                elif engine._tp_engine is None:
                     logits, self._slab = _slab_prefill_single(
                         engine.cfg, engine.params, jnp.asarray(padded), self._slab,
                         jnp.int32(stream.row), jnp.int32(stream.pos), jnp.int32(c),
@@ -711,92 +794,215 @@ class BatchScheduler:
         return logits, c - 1
 
     # ------------------------------------------------------------------
-    # Prefix cache (ISSUE 4): admission-time match/gather + publish.
-    # Tree state, slab and pool all mutate under the cond lock; the device
-    # programs themselves are async dispatches whose ordering the device
-    # stream guarantees (a gather dispatched before a publish reads the
-    # pool version it was built against).
+    # Prefix cache (ISSUE 4 + 7): admission-time match/alias-bind +
+    # publish. Tree state, slab, pool and every row's alias state mutate
+    # under the cond lock; the device programs themselves are async
+    # dispatches whose ordering the device stream guarantees (a paged read
+    # dispatched before a publish reads the pool version it was built
+    # against — releasing pins mid-flight is therefore safe: any eviction/
+    # republish only manifests as a LATER device program).
     # ------------------------------------------------------------------
 
-    def _gather_matched(self, stream: BatchStream, tokens: np.ndarray) -> list:
+    def _match_alias(self, stream: BatchStream, tokens: np.ndarray) -> list:
         """Walk the radix tree for the prompt's longest published prefix
-        and bind the matched pages to the row (copy into the slab). Returns
-        the matched (ref-held) chain; the row's position advances past the
-        matched tokens, so only the suffix prefills."""
+        and bind it to the row ZERO-COPY: the row records the chain's page
+        ids as its page table and advances its position past the matched
+        tokens — no bytes move; the suffix prefill's (and every later
+        step's) attention reads the pages through the table. The chain's
+        refs stay held for the row's lifetime."""
         prefix = self._prefix
-        page = prefix.page
-        engine = self.engine
         with self._cond:
+            # unwind any stale alias left by a caller that skipped reset
+            self._release_pins_locked(stream)
             chain = prefix.match(tokens)
             if not chain:
                 return []
-            n_pages = len(chain)
-            bucket = _page_bucket(n_pages)
-            # pad sentinel: CEIL(S/page), so every padded slot lands at or
-            # beyond S and drops — a floor sentinel with S % page != 0
-            # would write page 0's bytes into the row tail
-            s_pages = -(-engine.cfg.seq_len // page)
-            ids = np.zeros(bucket, np.int32)
-            dest = np.full(bucket, s_pages, np.int32)  # padded entries drop
-            ids[:n_pages] = [nd.page_id for nd in chain]
-            dest[:n_pages] = np.arange(n_pages)
-            with engine._tel.span(
-                "prefix_gather", pages=n_pages, batch_row=stream.row
-            ):
-                try:
-                    self._slab = _gather_pages(
-                        page, self._slab, self._pool, jnp.asarray(ids),
-                        jnp.asarray(dest), jnp.int32(stream.row),
-                    )
-                except BaseException:
-                    # a failed gather dispatch must not leave the chain
-                    # ref-pinned against eviction forever; the request
-                    # itself fails (the row's prefix bytes are undefined)
-                    prefix.release(chain)
-                    raise
-            stream.pos = n_pages * page
+            stream._alias_chain = chain
+            stream._alias_ids = [nd.page_id for nd in chain]
+            stream.matched_len = len(chain) * prefix.page
+            stream.pos = stream.matched_len
         return chain
 
     def _publish_row(self, stream: BatchStream, tokens: np.ndarray, chain: list) -> None:
         """Publish the admission prefill's completed full pages back into
-        the tree (blocks beyond the matched chain), then release the
-        chain's admission refs. Publishing copies OUT of the row into
-        fresh pool pages — the tree never aliases live row storage, so a
-        later quarantine/reset of this row cannot free or corrupt tree
-        pages (chaos-enforced, bench.py --prefix-cache --chaos)."""
+        the tree (blocks beyond the matched chain) — the ONLY copy in the
+        zero-copy design: the row's private suffix KV becomes immutable
+        shared pages. The matched chain's refs are NOT released here: the
+        row keeps reading those pages through its table until it resets,
+        quarantines or rolls back below them."""
         prefix = self._prefix
         page = prefix.page
         with self._cond:
-            try:
-                new_ids, new_blocks = prefix.publish(tokens, tokens.shape[0], chain)
-                if new_ids:
-                    bucket = _page_bucket(len(new_ids))
-                    ids = np.full(bucket, prefix.capacity, np.int32)  # pad drops
-                    src = np.zeros(bucket, np.int32)
-                    ids[: len(new_ids)] = new_ids
-                    src[: len(new_ids)] = new_blocks
-                    with self.engine._tel.span(
-                        "prefix_publish", pages=len(new_ids), batch_row=stream.row
-                    ):
-                        try:
+            new_ids, new_blocks = prefix.publish(tokens, tokens.shape[0], chain)
+            if new_ids:
+                bucket = _page_bucket(len(new_ids))
+                ids = np.full(bucket, prefix.capacity, np.int32)  # pad drops
+                src = np.zeros(bucket, np.int32)
+                ids[: len(new_ids)] = new_ids
+                src[: len(new_ids)] = new_blocks
+                with self.engine._tel.span(
+                    "prefix_publish", pages=len(new_ids), batch_row=stream.row
+                ):
+                    try:
+                        if self.engine._tp_engine is None:
                             self._pool = _publish_pages(
                                 page, self._slab, self._pool, jnp.asarray(ids),
                                 jnp.asarray(src), jnp.int32(stream.row),
                             )
-                        except BaseException as e:
-                            # the copy never dispatched: the just-inserted
-                            # nodes map blocks to pages holding garbage (or
-                            # a recycled prefix's stale bytes) — detach them
-                            # or every future match serves wrong KV. The
-                            # REQUEST is fine (its prefill completed):
-                            # publishing is an optimization, so swallow
-                            # everything except interpreter exits
-                            prefix.unpublish(tokens, new_ids, new_blocks)
-                            if not isinstance(e, Exception):
-                                raise
-                            print(f"⚠️ prefix publish failed; pages unwound: {e}")
-            finally:
-                prefix.release(chain)
+                        else:
+                            self._pool = self.engine._tp_engine.publish_pages(
+                                self._slab, self._pool, ids, src, stream.row,
+                            )
+                    except BaseException as e:
+                        # the copy never dispatched: the just-inserted
+                        # nodes map blocks to pages holding garbage (or
+                        # a recycled prefix's stale bytes) — detach them
+                        # or every future match serves wrong KV. The
+                        # REQUEST is fine (its prefill completed):
+                        # publishing is an optimization, so swallow
+                        # everything except interpreter exits
+                        prefix.unpublish(tokens, new_ids, new_blocks)
+                        if not isinstance(e, Exception):
+                            raise
+                        print(f"⚠️ prefix publish failed; pages unwound: {e}")
+
+    # ------------------------------------------------------------------
+    # Zero-copy alias lifetime (ISSUE 7): pins released at reset/
+    # quarantine, truncated on rollback; page tables materialized per
+    # dispatch under the cond lock.
+    # ------------------------------------------------------------------
+
+    def _release_pins_locked(self, stream: BatchStream) -> None:
+        """Release ``stream``'s page pins and clear its table (cond held).
+        Idempotent — quarantine and the subsequent reset both call it."""
+        if stream._alias_chain and self._prefix is not None:
+            self._prefix.release(stream._alias_chain)
+        stream._alias_chain = []
+        stream._alias_ids = []
+        stream.matched_len = 0
+
+    def _release_row_pins(self, stream: BatchStream) -> None:
+        if not stream._alias_chain:
+            # nothing pinned (the common miss/reset case): no lock needed —
+            # only this row's owner thread binds/clears its alias state
+            stream._alias_ids = []
+            stream.matched_len = 0
+            return
+        with self._cond:
+            self._release_pins_locked(stream)
+
+    def _truncate_alias(self, stream: BatchStream, pos: int) -> None:
+        """Shrink ``stream``'s alias to ``pos`` after a rollback below its
+        matched prefix: positions < pos keep reading the pool (a rollback
+        lands on a shared TOKEN prefix, so those pages' bytes stay the
+        right KV), pages wholly at or beyond ``pos`` lose their pins. The
+        next prefill writes the slab from ``pos`` up, and the per-position
+        select reads it there."""
+        with self._cond:
+            if stream.matched_len <= pos:
+                return
+            if self._prefix is not None:
+                keep = -(-pos // self._prefix.page)  # pages covering [0, pos)
+                drop = stream._alias_chain[keep:]
+                if drop:
+                    self._prefix.release(drop)
+                stream._alias_chain = stream._alias_chain[:keep]
+                stream._alias_ids = stream._alias_ids[:keep]
+            stream.matched_len = pos
+
+    def _fire_paged_attn_locked(self, joined):
+        """The ``engine.paged_attn`` fault site (chaos contract), fired per
+        joined row while a paged batched chunk — plain decode OR spec
+        verify — is built: a row-targeted raise quarantines ONLY the
+        victim, releases its page pins (the aliased pages stay live for
+        every other reader) and drops it from the dispatch; survivors
+        proceed bit-identically. Returns the surviving rows (those already
+        retired by an earlier failure filtered out too — they ride the
+        bucket masked-inactive: no cache write, no advance, no delivery)."""
+        if self._pool is not None:
+            for s in joined:
+                try:
+                    self._faults.fire("engine.paged_attn", row=s.row)
+                except Exception as e:
+                    err = faults.RowQuarantined(
+                        "batch row retired: paged-attention dispatch failed "
+                        "for this row"
+                    )
+                    err.__cause__ = e
+                    s._fetch_error = err
+                    self._release_pins_locked(s)
+                    self.engine._tel.rows_quarantined.inc()
+        return [s for s in joined if s._fetch_error is None]
+
+    def _alias_arrays_locked(self, rows, live_flags):
+        """Per-dispatch page tables [len(rows), n_table] + matched lengths
+        (cond held; ``live_flags`` is :meth:`_row_dispatch_arrays_locked`'s
+        liveness list — the ONE definition — not re-derived here): LIVE
+        rows without an alias (a miss, or retired mid-build) get matched 0
+        — the paged program reads their slab rows only, byte-identical to
+        the unpaged dispatch. Bucket-padding rows (not joined: outputs
+        discarded, cache writes dropped) instead get the max LIVE matched
+        length, so a partially-occupied bucket never drags
+        ``paged_segments``' pool-only bound down to the mixed path (which
+        reads pool AND slab for every row) — their zero tables read pool
+        page 0 garbage, which nothing observes."""
+        tables = np.zeros((len(rows), self._n_table), np.int32)
+        matched = np.zeros(len(rows), np.int32)
+        live = np.array(live_flags, bool)
+        for b, s in enumerate(rows):
+            if live[b] and s._alias_ids:
+                tables[b, : len(s._alias_ids)] = s._alias_ids
+                matched[b] = s.matched_len
+        if live.any():
+            matched[~live] = matched[live].max()
+        return jnp.asarray(tables), jnp.asarray(matched)
+
+    def _row_dispatch_arrays_locked(self, rows):
+        """Per-row arrays shared by the plain-decode and spec-verify chunk
+        builders (cond held): the liveness predicate plus positions /
+        active mask / sampling params / PRNG keys, inert defaults in
+        non-live slots (bucket padding, or rows retired mid-build), and
+        the zero-copy alias arrays when the pool is on (None otherwise).
+        One definition so a lifecycle change to what counts as a live row
+        can never reach one dispatch path and skip the other."""
+        zero_key = jax.random.PRNGKey(0)
+        live = [s._joined and s._fetch_error is None for s in rows]
+        pos = jnp.asarray(
+            [s.pos if ok else 0 for s, ok in zip(rows, live)], jnp.int32
+        )
+        active = jnp.asarray(live, bool)
+        temps = jnp.asarray(
+            [s._temperature if ok else 1.0 for s, ok in zip(rows, live)], jnp.float32
+        )
+        topps = jnp.asarray(
+            [s._topp if ok else 0.9 for s, ok in zip(rows, live)], jnp.float32
+        )
+        keys = jnp.stack(
+            [s._key if ok and s._key is not None else zero_key for s, ok in zip(rows, live)]
+        )
+        tables = matched = None
+        if self._pool is not None:
+            tables, matched = self._alias_arrays_locked(rows, live)
+        return live, pos, active, temps, topps, keys, tables, matched
+
+    def _alias_row_arrays_locked(self, stream: BatchStream):
+        """Single-row form of :meth:`_alias_arrays_locked` (the chunked
+        prefill dispatch)."""
+        table = np.zeros(self._n_table, np.int32)
+        table[: len(stream._alias_ids)] = stream._alias_ids
+        return jnp.asarray(table), jnp.int32(stream.matched_len)
+
+    def check_prefix(self) -> None:
+        """Tree invariants extended with alias tracking: no page freed or
+        unpinned while any live row's table references it (tests, bench
+        chaos gate)."""
+        with self._cond:
+            if self._prefix is not None:
+                self._prefix.check(
+                    row_pages=[
+                        list(s._alias_ids) for s in self._streams if s._alias_ids
+                    ]
+                )
 
     # ------------------------------------------------------------------
     # Join/leave (between chunks; the cond lock makes the active set
@@ -956,6 +1162,7 @@ class BatchScheduler:
                 err = faults.RowQuarantined(fail_msg)
                 err.__cause__ = error
                 s._fetch_error = err
+                self._release_pins_locked(s)
             self._cond.notify_all()
             return None
         return result
@@ -973,22 +1180,17 @@ class BatchScheduler:
         joined = [s for s in self._streams if s._joined]
         if not joined:
             return
+        joined = self._fire_paged_attn_locked(joined)
+        if not joined:
+            self._cond.notify_all()
+            return
         bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
         rows = self._streams[:bucket]
-        zero_key = jax.random.PRNGKey(0)
+        live, pos, active, temps, topps, keys, tables, matched = (
+            self._row_dispatch_arrays_locked(rows)
+        )
         first = jnp.stack(
-            [jnp.asarray(s._first if s._joined else 0, jnp.int32) for s in rows]
-        )
-        pos = jnp.asarray([s.pos if s._joined else 0 for s in rows], jnp.int32)
-        active = jnp.asarray([s._joined for s in rows], bool)
-        temps = jnp.asarray(
-            [s._temperature if s._joined else 1.0 for s in rows], jnp.float32
-        )
-        topps = jnp.asarray(
-            [s._topp if s._joined else 0.9 for s in rows], jnp.float32
-        )
-        keys = jnp.stack(
-            [s._key if s._joined and s._key is not None else zero_key for s in rows]
+            [jnp.asarray(s._first if ok else 0, jnp.int32) for s, ok in zip(rows, live)]
         )
         sw = Stopwatch()
 
@@ -997,12 +1199,29 @@ class BatchScheduler:
                 "batch_decode_chunk", bucket=bucket, active=len(joined),
                 steps=self.chunk,
             ):
-                if engine._tp_engine is None:
-                    from distributed_llama_tpu.models import sampling
+                from distributed_llama_tpu.models import sampling
 
-                    tokens, self._slab, new_keys = sampling.decode_chunk_batched(
-                        engine.cfg, engine.params, first, self._slab, pos,
-                        active, self.chunk, temps, topps, keys,
+                if engine._tp_engine is None:
+                    if self._pool is not None:
+                        tokens, self._slab, new_keys = (
+                            sampling.decode_chunk_batched_paged(
+                                engine.cfg, engine.params, first, self._slab,
+                                pos, active, self._pool, self.chunk, temps,
+                                topps, keys, tables, matched,
+                            )
+                        )
+                    else:
+                        tokens, self._slab, new_keys = sampling.decode_chunk_batched(
+                            engine.cfg, engine.params, first, self._slab, pos,
+                            active, self.chunk, temps, topps, keys,
+                        )
+                elif self._pool is not None:
+                    tokens, self._slab, new_keys = (
+                        engine._tp_engine.batched_decode_chunk_paged(
+                            engine.params, first, self._slab, self._pool, pos,
+                            active, self.chunk, temps, topps, keys, tables,
+                            matched,
+                        )
                     )
                 else:
                     tokens, self._slab, new_keys = (
@@ -1053,15 +1272,21 @@ class BatchScheduler:
         joined = [s for s in self._streams if s._joined]
         if not joined:
             return
+        joined = self._fire_paged_attn_locked(joined)
+        if not joined:
+            self._cond.notify_all()
+            return
         bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
         rows = self._streams[:bucket]
         T = self.spec_draft + 1
         S = engine.cfg.seq_len
-        zero_key = jax.random.PRNGKey(0)
         feed = np.zeros((bucket, T), np.int32)
         lens = np.zeros(bucket, np.int32)
-        for s in rows:
-            if not s._joined:
+        live, pos, active, temps, topps, keys, tables, matched = (
+            self._row_dispatch_arrays_locked(rows)
+        )
+        for s, ok in zip(rows, live):
+            if not ok:
                 continue
             feed[s.row, :] = int(s._first)  # pad tokens: overwritten KV
             # never draft past seq_len: the window writes pos..pos+T-1 and
@@ -1077,17 +1302,6 @@ class BatchScheduler:
                 if d:
                     feed[s.row, 1 : 1 + len(d)] = d
                     lens[s.row] = len(d)
-        pos = jnp.asarray([s.pos if s._joined else 0 for s in rows], jnp.int32)
-        active = jnp.asarray([s._joined for s in rows], bool)
-        temps = jnp.asarray(
-            [s._temperature if s._joined else 1.0 for s in rows], jnp.float32
-        )
-        topps = jnp.asarray(
-            [s._topp if s._joined else 0.9 for s in rows], jnp.float32
-        )
-        keys = jnp.stack(
-            [s._key if s._joined and s._key is not None else zero_key for s in rows]
-        )
         sw = Stopwatch()
         from distributed_llama_tpu.models import sampling
 
@@ -1096,11 +1310,21 @@ class BatchScheduler:
                 "spec_verify_chunk", bucket=bucket, active=len(joined),
                 window=T,
             ):
-                out, self._slab, new_keys = sampling.spec_verify_chunk_batched(
-                    engine.cfg, engine.params, jnp.asarray(feed),
-                    self._slab, pos, active, jnp.asarray(lens), temps,
-                    topps, keys,
-                )
+                if self._pool is not None:
+                    out, self._slab, new_keys = (
+                        sampling.spec_verify_chunk_batched_paged(
+                            engine.cfg, engine.params, jnp.asarray(feed),
+                            self._slab, pos, active, self._pool,
+                            jnp.asarray(lens), temps, topps, keys, tables,
+                            matched,
+                        )
+                    )
+                else:
+                    out, self._slab, new_keys = sampling.spec_verify_chunk_batched(
+                        engine.cfg, engine.params, jnp.asarray(feed),
+                        self._slab, pos, active, jnp.asarray(lens), temps,
+                        topps, keys,
+                    )
             return out, new_keys
 
         result = self._run_dispatch_locked(
@@ -1242,6 +1466,7 @@ class BatchScheduler:
                     )
                     err.__cause__ = error
                     s._fetch_error = err
+                    self._release_pins_locked(s)
                     tel.rows_quarantined.inc()
                     continue
                 s._queue.extend(int(t) for t in toks[:, s.row])
@@ -1321,6 +1546,7 @@ class BatchScheduler:
                     )
                     err.__cause__ = error if toks is None else bad.get(s.row)
                     s._fetch_error = err
+                    self._release_pins_locked(s)
                     tel.rows_quarantined.inc()
                     continue
                 col = emits[s.row]

@@ -30,6 +30,11 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     rule there quarantines ONLY the targeted row, its
                     co-batched survivors delivered bit-identically
                     (engine/batch.py ``_fetch``)
+``engine.paged_attn``  raise at a zero-copy paged-attention dispatch: fired
+                    per joined row while a paged batched chunk is built —
+                    a ``row=`` rule quarantines ONLY the targeted row AND
+                    releases its page pins (the aliased pages stay live
+                    for every other row; survivors bit-identical)
 ``tp.transfer``     raise/delay inside the transfer probe (the engine keeps
                     its last estimate instead of dying)
 ``server.send``     raise ``BrokenPipeError`` from the SSE chunk writer
@@ -112,6 +117,7 @@ SITES = (
     "engine.decode_dispatch",
     "engine.fetch",
     "engine.spec_verify",
+    "engine.paged_attn",
     "tp.transfer",
     "server.send",
 )

@@ -18,18 +18,22 @@ Design
   reason about) and its payload is one physical page id in the device page
   pool (:func:`~distributed_llama_tpu.models.llama.init_page_pool`).
 * Pages are **immutable once published**: the scheduler copies a row's
-  completed prefill KV *into* fresh pool pages (publish) and copies
-  matched pages *out* into a new row's slab prefix (admission gather) —
-  correctness-first copy semantics; rows never alias tree pages, so a
-  quarantined or reset row can NEVER free/corrupt pages the tree still
-  references (test- and chaos-enforced). Zero-copy paged attention is the
-  documented follow-up.
-* **Refcounts** pin a matched chain between the host-side match decision
-  and the device gather dispatch (the only window where eviction could
-  hand the page to a concurrent publish). ``refs == 0`` nodes are
-  evictable; eviction is leaf-first LRU (``last_use`` clock), so a chain
-  ages out from its deepest, least-shared end while shared system-prompt
-  roots survive.
+  completed prefill KV *into* fresh pool pages (publish — the ONLY copy in
+  the system). A matched row never copies pages back out: decode/verify/
+  prefill attention reads the matched prefix **zero-copy through a per-row
+  page table** over the pool (ops.attention paged variants), so each
+  cached byte exists exactly once and effective batch + cacheable-prefix
+  capacity both rise at fixed HBM. Writes still never touch tree pages —
+  a row's private suffix lives in its slab row.
+* **Refcounts** pin a matched chain for the **lifetime of the aliasing
+  row** (admission match → row reset/quarantine/rollback-truncation), not
+  just the admission window: eviction recycling a page that a live row's
+  attention reads through its table would serve another prompt's KV.
+  ``refs == 0`` nodes are evictable; eviction is leaf-first LRU
+  (``last_use`` clock), so a chain ages out from its deepest, least-shared
+  end while shared system-prompt roots survive. :meth:`check` extends to
+  alias tracking — callers pass the live rows' page tables and it asserts
+  none of those pages were freed or left unpinned.
 * The pool size (``--kv-pages``) IS the HBM budget: allocation evicts
   LRU-unreferenced leaves only when the free list runs dry, and fails
   softly (the scheduler simply skips publishing) when everything is
@@ -66,18 +70,30 @@ class PageNode:
 class PrefixCache:
     """Host-side index of the device page pool (see module docstring)."""
 
-    def __init__(self, n_pages: int, page: int):
+    def __init__(self, n_pages: int, page: int, page_bytes: int = 0):
         if n_pages < 1:
             raise ValueError(f"need at least one pool page, got {n_pages}")
         if page < 1:
             raise ValueError(f"page size must be positive, got {page}")
         self.page = page
         self.capacity = n_pages
+        # logical KV bytes per page across all layers/halves
+        # (llama.page_pool_bytes) — feeds the bytes gauge and the
+        # copy-traffic-saved counter; 0 = unknown (host-only unit tests)
+        self.page_bytes = int(page_bytes)
         self.free: list[int] = list(range(n_pages))
         self.root = PageNode(None, -1, None)
         self._clock = 0
+        # running count of refs>0 nodes, maintained at the 0<->1 ref
+        # transitions: the gauge updates on every match/release/publish
+        # under the scheduler cond lock, so an O(tree) walk there would
+        # serialize dispatch behind page-count bookkeeping at large
+        # --kv-pages (check() cross-validates this counter against a walk)
+        self._pinned = 0
         self.tel = telemetry.PrefixCacheInstruments()
         self.tel.pages.set(0)
+        self.tel.bytes.set(0)
+        self.tel.pinned_pages.set(0)
 
     # ------------------------------------------------------------------
     # Introspection (tests + metrics)
@@ -86,6 +102,22 @@ class PrefixCache:
     def pages_in_use(self) -> int:
         return self.capacity - len(self.free)
 
+    def pinned_pages(self) -> int:
+        """Pages whose refcount is held — by a live aliasing row (row
+        lifetime) or a publish in flight. Never evictable. O(1): a running
+        counter kept at the ref 0<->1 transitions."""
+        return self._pinned
+
+    def _ref(self, node: PageNode) -> None:
+        node.refs += 1
+        if node.refs == 1:
+            self._pinned += 1
+
+    def _unref(self, node: PageNode) -> None:
+        node.refs -= 1
+        if node.refs == 0:
+            self._pinned -= 1
+
     def _walk(self):
         stack = list(self.root.children.values())
         while stack:
@@ -93,21 +125,57 @@ class PrefixCache:
             yield node
             stack.extend(node.children.values())
 
-    def check(self) -> None:
+    def _set_pages_gauges(self) -> None:
+        used = self.pages_in_use()
+        self.tel.pages.set(used)
+        self.tel.bytes.set(used * self.page_bytes)
+
+    def _set_pinned_gauge(self) -> None:
+        self.tel.pinned_pages.set(self.pinned_pages())
+
+    def check(self, row_pages=None) -> None:
         """Structural invariants (tests + the eviction stress): every tree
-        page is allocated exactly once and disjoint from the free list."""
-        seen: set[int] = set()
+        page is allocated exactly once and disjoint from the free list.
+
+        ``row_pages``: iterable of live rows' aliased page-id sequences
+        (their zero-copy page tables). Each referenced page must still be
+        mapped in the tree AND ref-pinned — a page freed or unpinned while
+        a live row reads KV through it is the aliasing bug class this
+        extension exists to catch."""
+        seen: dict[int, PageNode] = {}
         for node in self._walk():
             assert 0 <= node.page_id < self.capacity, node.page_id
             assert node.page_id not in seen, f"page {node.page_id} aliased"
             assert node.refs >= 0, f"negative refcount on page {node.page_id}"
-            seen.add(node.page_id)
+            seen[node.page_id] = node
         free = set(self.free)
-        assert not (seen & free), f"tree/free overlap: {sorted(seen & free)}"
+        assert not (seen.keys() & free), (
+            f"tree/free overlap: {sorted(seen.keys() & free)}"
+        )
         assert len(seen) + len(free) == self.capacity, (
             f"page leak: {len(seen)} in tree + {len(free)} free "
             f"!= {self.capacity}"
         )
+        walked_pinned = sum(1 for n in seen.values() if n.refs > 0)
+        assert self._pinned == walked_pinned, (
+            f"pinned counter drift: running {self._pinned} "
+            f"!= walked {walked_pinned}"
+        )
+        for ids in row_pages or ():
+            for pid in ids:
+                assert pid not in free, (
+                    f"page {pid} freed while a live row's page table "
+                    "references it"
+                )
+                node = seen.get(pid)
+                assert node is not None, (
+                    f"page {pid} left the tree while a live row's page "
+                    "table references it"
+                )
+                assert node.refs > 0, (
+                    f"page {pid} unpinned while a live row aliases it "
+                    "(eviction could recycle it mid-read)"
+                )
 
     # ------------------------------------------------------------------
     # Match / release (admission)
@@ -121,8 +189,10 @@ class PrefixCache:
         """Longest chain of full-block matches STRICTLY shorter than the
         prompt (at least the last token always prefills — its logits seed
         the first sampled token). Acquires one ref per matched node; the
-        caller must :meth:`release` the returned chain once the gathered
-        pages have been dispatched."""
+        pins last for the LIFETIME of the aliasing row (its attention
+        reads the pages through its table every step), so the caller
+        :meth:`release`\\ s the chain at row reset/quarantine — not after
+        admission."""
         page = self.page
         max_blocks = (len(tokens) - 1) // page
         chain: list[PageNode] = []
@@ -135,18 +205,25 @@ class PrefixCache:
             node = child
         t = self._tick()
         for nd in chain:
-            nd.refs += 1
+            self._ref(nd)
             nd.last_use = t
         if chain:
             self.tel.hits.inc()
             self.tel.matched_tokens.observe(len(chain) * page)
+            # the copy design gathered every matched page into the slab row
+            # (and kept the duplicate for the row's lifetime): count the
+            # copy traffic the zero-copy read avoids per hit
+            self.tel.copy_bytes_saved.inc(len(chain) * self.page_bytes)
         else:
             self.tel.misses.inc()
+        self._set_pinned_gauge()
         return chain
 
     def release(self, chain: list[PageNode]) -> None:
         for nd in chain:
-            nd.refs -= 1
+            self._unref(nd)
+        if chain:
+            self._set_pinned_gauge()
 
     # ------------------------------------------------------------------
     # Publish (after a completed admission prefill)
@@ -174,7 +251,7 @@ class PrefixCache:
         # leaking the rest (reproduced: capacity-1 pool, 2-block publish)
         pinned: list[PageNode] = list(parent_chain)
         for nd in pinned:
-            nd.refs += 1
+            self._ref(nd)
         try:
             for i in range(len(parent_chain), n_total // page):
                 key = tuple(tokens[i * page : (i + 1) * page])
@@ -187,14 +264,15 @@ class PrefixCache:
                     node.children[key] = child
                     new_ids.append(pid)
                     new_blocks.append(i)
-                child.refs += 1
+                self._ref(child)
                 pinned.append(child)
                 child.last_use = t
                 node = child
         finally:
             for nd in pinned:
-                nd.refs -= 1
-        self.tel.pages.set(self.pages_in_use())
+                self._unref(nd)
+        self._set_pages_gauges()
+        self._set_pinned_gauge()
         return new_ids, new_blocks
 
     def unpublish(self, tokens, new_ids: list[int], new_blocks: list[int]) -> None:
@@ -212,9 +290,20 @@ class PrefixCache:
         for i in range(new_blocks[0]):
             node = node.children[tuple(tokens[i * page : (i + 1) * page])]
         first = new_blocks[0]
-        del node.children[tuple(tokens[first * page : (first + 1) * page])]
+        key = tuple(tokens[first * page : (first + 1) * page])
+        detached = node.children.pop(key)
+        # freshly-inserted nodes can't have been matched (both happen under
+        # the scheduler lock), so their refs are 0 — but keep the running
+        # pinned counter exact against any future lifecycle change
+        stack = [detached]
+        while stack:
+            nd = stack.pop()
+            if nd.refs > 0:
+                self._pinned -= 1
+            stack.extend(nd.children.values())
         self.free.extend(new_ids)
-        self.tel.pages.set(self.pages_in_use())
+        self._set_pages_gauges()
+        self._set_pinned_gauge()
 
     # ------------------------------------------------------------------
     # Allocation / LRU eviction
@@ -242,5 +331,5 @@ class PrefixCache:
         del victim.parent.children[victim.key]
         self.free.append(victim.page_id)
         self.tel.evictions.inc()
-        self.tel.pages.set(self.pages_in_use())
+        self._set_pages_gauges()
         return True

@@ -182,12 +182,20 @@ def attention(
     pos: jax.Array,
     rope_rows: jax.Array,
     axis_name: str | None,
+    paged=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Causal GQA attention for T new tokens at absolute positions
     pos..pos+T-1. ``cache_l``: this layer's cache — a ``(keys, values)``
     tuple of [S, Kl, hd] arrays (the layered layout, updated in place) or a
     stacked [2, S, Kl, hd] array (the lax.scan-over-layers layout); returns
     (attention mix [T, Hl*hd], updated cache in the same form).
+
+    ``paged``: ``(pool_k, pool_v, table, matched)`` — zero-copy prefix
+    aliasing for a slab row whose positions below ``matched`` live in the
+    shared page pool (read through the page table) rather than the row
+    itself. Blocked caches take the segmented paged scan; small/odd caches
+    read a virtual row view (``kv_cache.virtual_row``) through the SAME
+    einsum path, so both are bit-identical to a row holding page copies.
 
     Mirrors llamaQkv/llamaRope/llamaMultiheadAtt/llamaAtt
     (reference: src/llama2-tasks.cpp:33-108) with the per-timestep score loop
@@ -228,21 +236,33 @@ def attention(
     cdt = kvc.compute_dtype(keys)
     prec = kvc.einsum_precision(keys)
     qg = q.reshape(T, Kl, kv_mul, hd).astype(cdt)
-    if (
+    use_blocked = (
         S % ATT_CHUNK == 0
         and S > ATT_CHUNK
         and (T <= 8 or S >= ATT_BLOCK_PREFILL_S)
+    )
+    if paged is not None and not (
+        use_blocked and ATT_CHUNK % kvc.pool_page_size(paged[0]) == 0
     ):
+        # general fallback: a virtual row view selecting pool bytes below
+        # ``matched`` and the slab beyond, fed through the unchanged paths
+        pool_k, pool_v, table, matched = paged
+        keys = kvc.virtual_row(keys, pool_k, table, matched)
+        values = kvc.virtual_row(values, pool_v, table, matched)
+        paged = None
+    if use_blocked:
         # blocked (flash-style) attention with a DYNAMIC chunk bound: no
         # [T, S] score tensor materializes and slots beyond pos+T are never
         # read — the full-S einsum below reads the entire allocated cache
         # every call (S*K*hd*2 dtype-bytes per half per layer), which at
         # long seq_len dwarfs the live context (see ATT_CHUNK note above
-        # for the measured decode/prefill split)
+        # for the measured decode/prefill split); with ``paged`` still
+        # set, the same call reads the matched prefix through the page
+        # table (blocked_attention treats paged=None as the plain scan)
         from distributed_llama_tpu.ops.attention import blocked_attention
 
         att = blocked_attention(
-            qg.astype(jnp.float32), keys, values, pos, ATT_CHUNK
+            qg.astype(jnp.float32), keys, values, pos, ATT_CHUNK, paged=paged
         ).astype(jnp.float32).reshape(T, Hl * hd)
         return att, new_cache
     scores = kvc.scores_einsum(qg, keys, prec) / jnp.sqrt(jnp.float32(hd))
@@ -283,8 +303,11 @@ def block_forward(
     axis_name: str | None,
     ep_axis: str | None = None,
     n_real: jax.Array | None = None,
+    paged=None,
 ) -> tuple[jax.Array, jax.Array]:
-    att, new_cache = attention(cfg, x, lp, cache_l, pos, rope_rows, axis_name)
+    att, new_cache = attention(
+        cfg, x, lp, cache_l, pos, rope_rows, axis_name, paged=paged
+    )
     return (
         block_tail(cfg, x, att, lp, axis_name, ep_axis=ep_axis, n_real=n_real),
         new_cache,
@@ -300,6 +323,7 @@ def forward_tokens(
     axis_name: str | None = None,
     ep_axis: str | None = None,
     n_real: jax.Array | None = None,
+    paged=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Run T tokens through the model starting at absolute position ``pos``.
 
@@ -310,6 +334,10 @@ def forward_tokens(
     T=1 case. ``n_real``: real (non-pad) token count of a bucket-padded
     prompt — only the capacity-bucketed MoE prefill consumes it (pad rows
     must not spend per-expert bucket capacity); None = all rows real.
+    ``paged``: ``(pool, table, matched)`` — this row's cache positions
+    below ``matched`` live in the shared prefix-page pool (per-layer
+    ``(keys, values)`` halves, read through ``table``); requires the
+    layered cache layout.
     """
     T = tokens.shape[0]
     x = embed(cfg, params, tokens)
@@ -328,13 +356,19 @@ def forward_tokens(
         cache_is_list = isinstance(cache, (list, tuple))
         new_layers = []
         for l, lp in enumerate(params["layers"]):
+            paged_l = None
+            if paged is not None:
+                pool, table, matched = paged
+                paged_l = (pool[l][0], pool[l][1], table, matched)
             x, nc = block_forward(
                 cfg, x, lp, cache[l], pos, rope_rows, axis_name, ep_axis=ep_axis,
-                n_real=n_real,
+                n_real=n_real, paged=paged_l,
             )
             new_layers.append(nc)
         new_cache = type(cache)(new_layers) if cache_is_list else jnp.stack(new_layers)
     else:
+        if paged is not None:
+            raise ValueError("the paged (pool-aliased) read requires the layered cache")
 
         def body(carry, scanned):
             xc = carry
@@ -358,6 +392,7 @@ def attention_batched(
     pos: jax.Array,  # [B] per-row absolute positions
     rope_rows: jax.Array,  # [B, hd/2, 2] per-row rope table rows
     active: jax.Array,  # [B] bool — False rows decode garbage, write nothing
+    paged=None,  # (pool_k, pool_v, tables [B, n_table], matched [B])
 ) -> tuple[jax.Array, jax.Array]:
     """One decode step of B INDEPENDENT sequences over a slab cache with a
     leading batch axis: row ``b`` writes its K/V at its own ``pos[b]`` and
@@ -366,7 +401,10 @@ def attention_batched(
     one weight read per matrix per step — the whole point of batching an
     HBM-bound decode. Inactive rows write at a DROPPED out-of-bounds slot
     (retired caches stay byte-identical for prefix reuse) and their outputs
-    are garbage the scheduler discards."""
+    are garbage the scheduler discards. ``paged``: row ``b``'s positions
+    below ``matched[b]`` are read from the shared page pool through its
+    page table (zero-copy prefix aliasing) — bit-identical to a row holding
+    copies of the pages."""
     from distributed_llama_tpu.ops import kv_cache as kvc
 
     B = x.shape[0]
@@ -393,11 +431,15 @@ def attention_batched(
     # inactive rows read from position 0 so they cannot inflate the shared
     # dynamic chunk bound (their output is garbage either way)
     read_pos = jnp.where(active, pos, 0)
-    if S % ATT_CHUNK == 0 and S > ATT_CHUNK:
+    use_blocked = S % ATT_CHUNK == 0 and S > ATT_CHUNK
+    if use_blocked and (
+        paged is None or ATT_CHUNK % kvc.pool_page_size(paged[0]) == 0
+    ):
         from distributed_llama_tpu.ops.attention import batched_decode_attention
 
         att = batched_decode_attention(
-            qg.astype(jnp.float32), keys, values, read_pos, ATT_CHUNK
+            qg.astype(jnp.float32), keys, values, read_pos, ATT_CHUNK,
+            paged=paged,
         ).astype(jnp.float32)
         return att.reshape(B, Hl * hd), new_cache
     # a dispatch bucket below B_max reads only its own slab rows
@@ -405,6 +447,19 @@ def attention_batched(
     values_b = (
         values if values.shape[0] == B else kvc.slice_rows_batched(values, 0, S, rows=B)
     )
+    if paged is not None:
+        # virtual slab view (pool bytes below matched) through the same
+        # einsum/blocked path — the small/odd-cache fallback
+        pool_k, pool_v, tables, matched = paged
+        keys_b = kvc.virtual_rows_batched(keys_b, pool_k, tables, matched)
+        values_b = kvc.virtual_rows_batched(values_b, pool_v, tables, matched)
+        if use_blocked:
+            from distributed_llama_tpu.ops.attention import batched_decode_attention
+
+            att = batched_decode_attention(
+                qg.astype(jnp.float32), keys_b, values_b, read_pos, ATT_CHUNK
+            ).astype(jnp.float32)
+            return att.reshape(B, Hl * hd), new_cache
     scores = kvc.scores_einsum_batched(qg, keys_b, prec) / jnp.sqrt(jnp.float32(hd))
     mask = jnp.arange(S)[None, :] <= read_pos[:, None]  # [B, S]
     scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
@@ -421,6 +476,7 @@ def forward_step_batched(
     pos: jax.Array,  # int32 [B] per-row positions
     active: jax.Array,  # bool [B]
     axis_name: str | None = None,
+    paged=None,  # (pool, tables, matched) — zero-copy prefix aliasing
 ) -> tuple[jax.Array, jax.Array]:
     """One batched decode step: B tokens (one per sequence) at per-row
     positions through the whole model, reading each weight matrix ONCE.
@@ -445,7 +501,13 @@ def forward_step_batched(
         raise ValueError("batched decode requires the per-layer-list params layout")
     new_layers = []
     for l, lp in enumerate(layers):
-        att, nc = attention_batched(cfg, x, lp, cache[l], pos, rope_rows, active)
+        paged_l = None
+        if paged is not None:
+            pool, tables, matched = paged
+            paged_l = (pool[l][0], pool[l][1], tables, matched)
+        att, nc = attention_batched(
+            cfg, x, lp, cache[l], pos, rope_rows, active, paged=paged_l
+        )
         x = block_tail(cfg, x, att, lp, axis_name)
         new_layers.append(nc)
     return final_logits(cfg, params, x), type(cache)(new_layers)
@@ -459,6 +521,7 @@ def attention_verify_batched(
     pos: jax.Array,  # [B] absolute position of each row's window start
     rope_rows: jax.Array,  # [B, T, hd/2, 2] per-(row, offset) rope rows
     active: jax.Array,  # [B] bool — False rows verify garbage, write nothing
+    paged=None,  # (pool_k, pool_v, tables [B, n_table], matched [B])
 ) -> tuple[jax.Array, jax.Array]:
     """One speculative-verify attention step of B independent T-token
     windows (T = draft k + 1): row ``b``'s query ``t`` sits at ``pos[b]+t``,
@@ -499,17 +562,32 @@ def attention_verify_batched(
     prec = kvc.einsum_precision(keys)
     qg = q.reshape(B, T, Kl, kv_mul, hd).astype(cdt)
     read_pos = jnp.where(active, pos, 0)
-    if S % ATT_CHUNK == 0 and S > ATT_CHUNK:
+    use_blocked = S % ATT_CHUNK == 0 and S > ATT_CHUNK
+    if use_blocked and (
+        paged is None or ATT_CHUNK % kvc.pool_page_size(paged[0]) == 0
+    ):
         from distributed_llama_tpu.ops.attention import batched_verify_attention
 
         att = batched_verify_attention(
-            qg.astype(jnp.float32), keys, values, read_pos, ATT_CHUNK
+            qg.astype(jnp.float32), keys, values, read_pos, ATT_CHUNK,
+            paged=paged,
         ).astype(jnp.float32)
         return att.reshape(B, T, Hl * hd), new_cache
     keys_b = keys if keys.shape[0] == B else kvc.slice_rows_batched(keys, 0, S, rows=B)
     values_b = (
         values if values.shape[0] == B else kvc.slice_rows_batched(values, 0, S, rows=B)
     )
+    if paged is not None:
+        pool_k, pool_v, tables, matched = paged
+        keys_b = kvc.virtual_rows_batched(keys_b, pool_k, tables, matched)
+        values_b = kvc.virtual_rows_batched(values_b, pool_v, tables, matched)
+        if use_blocked:
+            from distributed_llama_tpu.ops.attention import batched_verify_attention
+
+            att = batched_verify_attention(
+                qg.astype(jnp.float32), keys_b, values_b, read_pos, ATT_CHUNK
+            ).astype(jnp.float32)
+            return att.reshape(B, T, Hl * hd), new_cache
     scores = kvc.scores_einsum_verify(qg, keys_b, prec) / jnp.sqrt(jnp.float32(hd))
     # causal mask per (row, offset): query t of row b sees slots 0..pos[b]+t
     q_pos = read_pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
@@ -528,6 +606,7 @@ def forward_verify_batched(
     pos: jax.Array,  # int32 [B] per-row positions of tokens[:, 0]
     active: jax.Array,  # bool [B]
     axis_name: str | None = None,
+    paged=None,  # (pool, tables, matched) — zero-copy prefix aliasing
 ) -> tuple[jax.Array, jax.Array]:
     """The speculative-decode verify forward: score every row's T-token
     window (previous token + k prompt-lookup drafts) in ONE weight read.
@@ -550,8 +629,12 @@ def forward_verify_batched(
         raise ValueError("batched verify requires the per-layer-list params layout")
     new_layers = []
     for l, lp in enumerate(layers):
+        paged_l = None
+        if paged is not None:
+            pool, tables, matched = paged
+            paged_l = (pool[l][0], pool[l][1], tables, matched)
         att, nc = attention_verify_batched(
-            cfg, x, lp, cache[l], pos, rope_rows, active
+            cfg, x, lp, cache[l], pos, rope_rows, active, paged=paged_l
         )
         x = block_tail(
             cfg, x.reshape(B * T, -1), att.reshape(B * T, -1), lp, axis_name
@@ -589,9 +672,11 @@ def init_page_pool(
 ) -> list[tuple[jax.Array, jax.Array]]:
     """Prefix-cache page pool: a list of per-layer ``(keys, values)`` halves
     of [n_pages, page, Kl, hd] (engine.prefix_cache). Pages hold immutable,
-    refcounted KV prefixes published from slab rows; its HBM budget is
-    n_pages * page * Kl * hd * 2 dtype-bytes per layer — configured with
-    ``--kv-pages`` on the serving surface."""
+    refcounted KV prefixes published from slab rows; decode attention reads
+    them zero-copy through per-row page tables (ops.attention paged
+    variants), so each cached byte exists exactly once. The HBM budget is
+    n_pages * :func:`page_pool_bytes` — configured with ``--kv-pages`` on
+    the serving surface."""
     from distributed_llama_tpu.ops import kv_cache as kvc
 
     kl = n_kv_heads_local if n_kv_heads_local is not None else cfg.n_kv_heads
@@ -602,6 +687,20 @@ def init_page_pool(
         )
         for _ in range(cfg.n_layers)
     ]
+
+
+def page_pool_bytes(cfg: LlamaConfig, page: int, dtype) -> int:
+    """Logical KV bytes one pool page holds across all layers and both
+    halves (the telemetry/bench accounting unit for pool occupancy and the
+    copy traffic zero-copy aliasing avoids)."""
+    from distributed_llama_tpu.ops import kv_cache as kvc
+
+    kl, hd = cfg.n_kv_heads, cfg.head_size
+    if kvc.is_quantized_cache_dtype(dtype):
+        per_half = page * kl * hd + page * kl * 4  # int8 data + f32 scales
+    else:
+        per_half = page * kl * hd * jnp.dtype(dtype).itemsize
+    return 2 * cfg.n_layers * per_half
 
 
 def init_cache(

@@ -189,6 +189,7 @@ def batched_decode_scan(
     temperature: jax.Array,  # [B]
     topp: jax.Array,  # [B]
     axis_name: str | None = None,
+    paged=None,  # (pool, tables, matched) — zero-copy prefix aliasing
 ):
     """The batched decode body: B sequences step together, each weight
     matrix read once per step. Per row it is the same forward → split →
@@ -197,12 +198,16 @@ def batched_decode_scan(
     single-stream chunked decode for the same per-row key. Inactive rows
     compute garbage (masked out of cache writes and position advances) so
     requests can join/leave between chunks without a recompile. Returns
-    (tokens [n_steps, B], cache, advanced keys [B, 2])."""
+    (tokens [n_steps, B], cache, advanced keys [B, 2]). ``paged``: each
+    row's matched prompt prefix is read from the shared page pool through
+    its page table instead of the slab (the pool rides the scan as a
+    read-only closure capture — no copy, no donation)."""
 
     def step(carry, _):
         tokens, cache_c, p, ks = carry
         logits, cache_c = llama.forward_step_batched(
-            cfg, params, tokens, cache_c, p, active, axis_name=axis_name
+            cfg, params, tokens, cache_c, p, active, axis_name=axis_name,
+            paged=paged,
         )
         if axis_name is not None and logits.shape[-1] != cfg.vocab_size:
             logits = jax.lax.all_gather(logits, axis_name, axis=1, tiled=True)
@@ -243,6 +248,33 @@ def decode_chunk_batched(
     return batched_decode_scan(
         cfg, params, first_tokens, cache, pos, active, keys, n_steps,
         temperature, topp,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(3,))
+def decode_chunk_batched_paged(
+    cfg: LlamaConfig,
+    params,
+    first_tokens: jax.Array,
+    cache,
+    pos: jax.Array,
+    active: jax.Array,
+    pool,  # per-layer (keys, values) page-pool halves — READ-ONLY
+    n_steps: int,
+    temperature: jax.Array,
+    topp: jax.Array,
+    keys: jax.Array,
+    tables: jax.Array,  # int32 [B, n_table] per-row page tables
+    matched: jax.Array,  # int32 [B] aliased prefix lengths (0 = no alias)
+):
+    """:func:`decode_chunk_batched` with zero-copy prefix aliasing: rows
+    whose prompt hit the radix cache read their matched prefix straight out
+    of the shared page pool every step — no gathered slab duplicate exists.
+    Only the slab is donated; the pool is shared across every row and
+    dispatch, so it must never alias."""
+    return batched_decode_scan(
+        cfg, params, first_tokens, cache, pos, active, keys, n_steps,
+        temperature, topp, paged=(pool, tables, matched),
     )
 
 
@@ -374,6 +406,35 @@ def spec_verify_chunk_batched(
     dropped cache slots, exactly like the plain batched chunk."""
     logits, cache = llama.forward_verify_batched(
         cfg, params, feed, cache, pos, active
+    )
+    n_emit, tokens, new_keys = jax.vmap(_spec_accept_row)(
+        logits, feed[:, 1:], draft_len, keys, temperature, topp
+    )
+    return jnp.concatenate([n_emit[:, None], tokens], axis=1), cache, new_keys
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def spec_verify_chunk_batched_paged(
+    cfg: LlamaConfig,
+    params,
+    feed: jax.Array,  # int32 [B, T] per-row [prev, drafts...] windows
+    cache,
+    pos: jax.Array,  # int32 [B]
+    active: jax.Array,  # bool [B]
+    pool,  # per-layer (keys, values) page-pool halves — READ-ONLY
+    draft_len: jax.Array,  # int32 [B]
+    temperature: jax.Array,  # [B]
+    topp: jax.Array,  # [B]
+    keys: jax.Array,  # [B, 2]
+    tables: jax.Array,  # int32 [B, n_table]
+    matched: jax.Array,  # int32 [B]
+):
+    """:func:`spec_verify_chunk_batched` with zero-copy prefix aliasing:
+    verify windows attend over pool pages for the matched prefix and the
+    slab row for the private suffix, bit-identical to the copied-prefix
+    verify (the spec × prefix-cache parity contract)."""
+    logits, cache = llama.forward_verify_batched(
+        cfg, params, feed, cache, pos, active, paged=(pool, tables, matched)
     )
     n_emit, tokens, new_keys = jax.vmap(_spec_accept_row)(
         logits, feed[:, 1:], draft_len, keys, temperature, topp

@@ -76,6 +76,70 @@ def merge_partials(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
+def paged_segments(matched, chunk: int, n_chunks):
+    """Segment bounds of a paged blocked scan: chunks [0, a) hold positions
+    below EVERY row's ``matched`` (pool-only reads), chunks [a, b) mix pool
+    and slab per position, chunks [b, n_chunks) are past every row's matched
+    length (slab-only — zero pool traffic once decode is deep). ``matched``
+    may be a scalar (single row) or a [B] vector."""
+    a = jnp.minimum(jax.lax.div(jnp.min(matched), chunk), n_chunks)
+    b = jnp.clip(jax.lax.div(jnp.max(matched) + chunk - 1, chunk), a, n_chunks)
+    return a, b
+
+
+def _segmented_batched_scan(partial, keys, values, paged, chunk: int, n_chunks, init, rows: int):
+    """The batched paged chunk scan shared by decode and verify attention:
+    run ``partial(kc, vc, start, carry)`` over every chunk, reading each
+    chunk from the slab (``paged`` None), or through the pool-only / mixed /
+    slab-only segment split (:func:`paged_segments`) with per-position
+    byte selects in the mixed span. One definition so a fix to the segment
+    logic can never reach one caller and skip the other.
+
+    Parity scope: the segments keep one fori_loop each — decode must not
+    pay a pool gather on slab-only chunks — which means a backend whose
+    per-loop codegen differs could perturb the merge by ulps (the
+    mechanism that forced :func:`blocked_attention`'s paged prefill to a
+    single mixed loop). Bit-parity vs the copy path is test-enforced on
+    the CPU mesh; the hit-vs-cold parity tests are the tripwire on any
+    new backend."""
+
+    def slab_chunk(i):
+        return (
+            kvc.slice_rows_batched(keys, i * chunk, chunk, rows=rows),
+            kvc.slice_rows_batched(values, i * chunk, chunk, rows=rows),
+        )
+
+    def body_slab(i, carry):
+        kc, vc = slab_chunk(i)
+        return partial(kc, vc, i * chunk, carry)
+
+    if paged is None:
+        return jax.lax.fori_loop(0, n_chunks, body_slab, init)
+
+    pool_k, pool_v, tables, matched = paged
+    ppc = chunk // kvc.pool_page_size(pool_k)
+    a, b = paged_segments(matched, chunk, n_chunks)
+
+    def body_pool(i, carry):
+        kc = kvc.pool_chunk(pool_k, tables, i, ppc)
+        vc = kvc.pool_chunk(pool_v, tables, i, ppc)
+        return partial(kc, vc, i * chunk, carry)
+
+    def body_mixed(i, carry):
+        kc_s, vc_s = slab_chunk(i)
+        kc_p = kvc.pool_chunk(pool_k, tables, i, ppc)
+        vc_p = kvc.pool_chunk(pool_v, tables, i, ppc)
+        sel = (i * chunk + jnp.arange(chunk))[None, :] < matched[:, None]
+        return partial(
+            kvc.select_kv(sel, kc_p, kc_s), kvc.select_kv(sel, vc_p, vc_s),
+            i * chunk, carry,
+        )
+
+    carry = jax.lax.fori_loop(0, a, body_pool, init)
+    carry = jax.lax.fori_loop(a, b, body_mixed, carry)
+    return jax.lax.fori_loop(b, n_chunks, body_slab, carry)
+
+
 def blocked_partials(
     qg: jax.Array,  # [T, K, M, hd] f32 grouped queries
     keys,  # local cache slice [Sl, K, hd] (array or QuantizedKV)
@@ -116,6 +180,7 @@ def batched_decode_attention(
     values,
     pos: jax.Array,  # [B] per-row absolute positions (inactive rows: 0)
     chunk: int,
+    paged=None,  # (pool_k, pool_v, tables [B, n_table], matched [B])
 ) -> jax.Array:
     """Blocked causal attention of B independent single-token queries, each
     over its OWN slab cache row, masked by its OWN position: row ``b`` sees
@@ -126,7 +191,16 @@ def batched_decode_attention(
     [B, K, M, hd] f32. Requires S % chunk == 0 (callers fall back to the
     full-S einsum otherwise, exactly like the single-stream path). The
     slab may hold MORE rows than B (a dispatch bucket below B_max): only
-    the first B rows are read."""
+    the first B rows are read.
+
+    With ``paged`` set (zero-copy prefix aliasing), row ``b``'s positions
+    below ``matched[b]`` are read from the shared page pool THROUGH its page
+    table instead of the slab: the scan splits into pool-only, mixed and
+    slab-only segments (:func:`paged_segments`) visiting the SAME chunk
+    indices in the same merge order with byte-identical KV (pages hold the
+    exact bytes the copy design gathered), so the output is bit-identical
+    to the copy path's. Requires chunk % page == 0 (callers fall back to
+    the virtual-row einsum otherwise)."""
     B, K, M, hd = qg.shape
     S = keys.shape[1]
     cdt = kvc.compute_dtype(keys)
@@ -134,11 +208,8 @@ def batched_decode_attention(
     live = jnp.clip(jnp.max(pos) + 1, 0, S)
     n_chunks = jax.lax.div(live + chunk - 1, chunk)
 
-    def body(i, carry):
+    def partial(kc, vc, start, carry):
         m, l, o = carry
-        start = i * chunk
-        kc = kvc.slice_rows_batched(keys, start, chunk, rows=B)
-        vc = kvc.slice_rows_batched(values, start, chunk, rows=B)
         k_pos = start + jnp.arange(chunk)
         scores = kvc.scores_einsum_batched(qg.astype(cdt), kc, prec) / jnp.sqrt(
             jnp.float32(hd)
@@ -158,7 +229,9 @@ def batched_decode_attention(
     m0 = jnp.full((B, K, M), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, K, M), jnp.float32)
     o0 = jnp.zeros((B, K, M, hd), jnp.float32)
-    m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+    m, l, o = _segmented_batched_scan(
+        partial, keys, values, paged, chunk, n_chunks, (m0, l0, o0), rows=B
+    )
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
@@ -168,6 +241,7 @@ def batched_verify_attention(
     values,
     pos: jax.Array,  # [B] per-row positions of query t=0 (inactive rows: 0)
     chunk: int,
+    paged=None,  # (pool_k, pool_v, tables [B, n_table], matched [B])
 ) -> jax.Array:
     """Blocked causal attention of B independent T-token verify windows
     (speculative decode): row ``b``'s query ``t`` sits at absolute position
@@ -177,7 +251,12 @@ def batched_verify_attention(
     read; fully-masked chunks merge as exact identities (empty partials),
     which keeps each query's output bit-identical to the single-token
     decode step at the same position. Returns [B, T, K, M, hd] f32.
-    Requires S % chunk == 0 (callers fall back to the full-S einsum)."""
+    Requires S % chunk == 0 (callers fall back to the full-S einsum).
+
+    ``paged``: the zero-copy prefix read, segmented exactly like
+    :func:`batched_decode_attention` — the verify window always sits at
+    pos >= matched, so every paged position is causally visible to every
+    query offset and the per-chunk math is unchanged."""
     B, T, K, M, hd = qg.shape
     S = keys.shape[1]
     cdt = kvc.compute_dtype(keys)
@@ -186,11 +265,8 @@ def batched_verify_attention(
     n_chunks = jax.lax.div(live + chunk - 1, chunk)
     q_pos = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
 
-    def body(i, carry):
+    def partial(kc, vc, start, carry):
         m, l, o = carry
-        start = i * chunk
-        kc = kvc.slice_rows_batched(keys, start, chunk, rows=B)
-        vc = kvc.slice_rows_batched(values, start, chunk, rows=B)
         k_pos = start + jnp.arange(chunk)
         scores = kvc.scores_einsum_verify(qg.astype(cdt), kc, prec) / jnp.sqrt(
             jnp.float32(hd)
@@ -208,7 +284,9 @@ def batched_verify_attention(
     m0 = jnp.full((B, T, K, M), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, T, K, M), jnp.float32)
     o0 = jnp.zeros((B, T, K, M, hd), jnp.float32)
-    m, l, o = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
+    m, l, o = _segmented_batched_scan(
+        partial, keys, values, paged, chunk, n_chunks, (m0, l0, o0), rows=B
+    )
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
@@ -218,6 +296,7 @@ def blocked_attention(
     values,
     pos: jax.Array,  # scalar: absolute position of query row 0
     chunk: int,
+    paged=None,  # (pool_k, pool_v, table [n_table], matched scalar)
 ) -> jax.Array:
     """Causal attention of T query rows over a KV cache, blocked along the
     key axis with a DYNAMIC chunk bound: only chunks holding positions
@@ -228,9 +307,46 @@ def blocked_attention(
     Requires S % chunk == 0 (callers fall back to the full einsum
     otherwise). The boundary chunk's causal edge is masked inside
     :func:`chunk_attention` by position comparison.
-    """
+
+    ``paged``: zero-copy prefix aliasing for the slab-row prefill — cache
+    positions below ``matched`` are read from the page pool through the
+    row's page table. ONE fori_loop covers every chunk with a per-position
+    pool-vs-slab byte select: splitting the scan into pool/mixed/slab
+    segment loops (as the batched decode does) compiles the shared body
+    once PER SEGMENT LOOP, and XLA's per-loop codegen perturbs the o-merge
+    FMA by ulps — a single loop is the only structure whose chunk-1..n
+    math is bit-identical to the non-paged single-loop scan. The extra
+    pool read on suffix-only chunks is a prefill-only cost (decode's hot
+    path keeps the segmented scan). Requires chunk % page == 0."""
     T = qg.shape[0]
-    # same chunk scan as the sequence-parallel local-slice partials, with
-    # the whole cache as the "local slice" (base 0) and a local normalize
-    m, l, o = blocked_partials(qg, keys, values, pos + jnp.arange(T), 0, chunk)
+    q_pos = pos + jnp.arange(T)
+    if paged is None:
+        # same chunk scan as the sequence-parallel local-slice partials, with
+        # the whole cache as the "local slice" (base 0) and a local normalize
+        m, l, o = blocked_partials(qg, keys, values, q_pos, 0, chunk)
+        return o / jnp.maximum(l, 1e-30)[..., None]
+
+    pool_k, pool_v, table, matched = paged
+    K, M, hd = qg.shape[1:]
+    Sl = keys.shape[0]
+    ppc = chunk // kvc.pool_page_size(pool_k)
+    live = jnp.clip(q_pos[-1] + 1, 0, Sl)
+    n_chunks = jax.lax.div(live + chunk - 1, chunk)
+
+    def body_mixed(i, carry):
+        kc_s = kvc.slice_rows(keys, i * chunk, chunk)
+        vc_s = kvc.slice_rows(values, i * chunk, chunk)
+        kc_p = kvc.pool_chunk_row(pool_k, table, i, ppc)
+        vc_p = kvc.pool_chunk_row(pool_v, table, i, ppc)
+        sel = (i * chunk + jnp.arange(chunk)) < matched
+        kc = kvc.select_kv(sel, kc_p, kc_s)
+        vc = kvc.select_kv(sel, vc_p, vc_s)
+        ms, ls, os_ = chunk_attention(qg, kc, vc, q_pos, i * chunk + jnp.arange(chunk))
+        m, l, o = carry
+        return merge_partials(m, l, o, ms, ls, os_)
+
+    m0 = jnp.full((T, K, M), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((T, K, M), jnp.float32)
+    o0 = jnp.zeros((T, K, M, hd), jnp.float32)
+    m, l, o = jax.lax.fori_loop(0, n_chunks, body_mixed, (m0, l0, o0))
     return o / jnp.maximum(l, 1e-30)[..., None]

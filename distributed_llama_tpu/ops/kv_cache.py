@@ -240,8 +240,12 @@ def slab_put_row(half, row_half, row):
 # Page pool (engine.prefix_cache): immutable prefix KV pages shared across
 # requests. A pool half is [P, page, K, hd] — the same dtype/pytree rules as
 # the slab (i8 pools carry [P, page, K, 1] scales), so published pages hold
-# the EXACT cache bytes of the row they came from and a gather restores them
-# bit-identically (the prefix-hit == cold-prefill parity contract).
+# the EXACT cache bytes of the row they came from, and the zero-copy paged
+# read (pool_chunk/select_kv below, consumed by ops.attention's paged
+# variants) sees bytes identical to what the PR 4 copy design gathered into
+# the slab (the prefix-hit == cold-prefill bit-parity contract). Cached
+# bytes exist ONCE — in the pool — and rows alias them through per-row page
+# tables instead of holding duplicates.
 # ---------------------------------------------------------------------------
 
 
@@ -251,32 +255,78 @@ def init_page_pool_half(n_pages: int, page: int, kl: int, hd: int, dtype):
     return init_half((n_pages, page, kl, hd), dtype)
 
 
-def gather_pages_to_row(slab_half, pool_half, page_ids, dest_page, row, page: int):
-    """Copy pool pages ``page_ids[i]`` into slab row ``row`` at page slots
-    ``dest_page[i]`` (positions dest_page[i]*page .. +page-1). Both index
-    arrays are traced (one compiled program per padded page-count bucket);
-    the drop is PER SLOT (slot >= S), so the inert pad sentinel is
-    ``ceil(S/page)`` — a floor sentinel would land partially in bounds when
-    page does not divide S and clobber the row tail. Returns the updated
-    slab half (callers donate the slab)."""
-    p_idx = jnp.arange(page)
-    if isinstance(slab_half, QuantizedKV):
-        slots = (dest_page[:, None] * page + p_idx[None, :]).reshape(-1)
-        vals = pool_half.data[page_ids]  # [Np, page, K, hd]
-        scal = pool_half.scales[page_ids]
+def pool_page_size(pool_half) -> int:
+    """Static page size of a pool half ([P, page, K, hd] — shapes are known
+    at trace time, so paged-vs-plain branching stays Python-level)."""
+    return (pool_half.data if isinstance(pool_half, QuantizedKV) else pool_half).shape[1]
+
+
+def gather_pool_pages(pool_half, ids):
+    """Read pool pages ``ids`` [..., n] -> [..., n*page, K, hd]: the
+    zero-copy page-table read. The gathered positions are CONSUMED by the
+    attention einsums in-register — nothing is written back to the slab, so
+    cached bytes exist exactly once (in the pool). Out-of-bounds ids clamp
+    (jnp gather default); callers mask those positions out by ``matched``."""
+    if isinstance(pool_half, QuantizedKV):
+        d = pool_half.data[ids]  # [..., n, page, K, hd]
+        s = pool_half.scales[ids]
         return QuantizedKV(
-            slab_half.data.at[row, slots].set(
-                vals.reshape((-1,) + vals.shape[2:]), mode="drop"
-            ),
-            slab_half.scales.at[row, slots].set(
-                scal.reshape((-1,) + scal.shape[2:]), mode="drop"
-            ),
+            d.reshape(d.shape[:-4] + (-1,) + d.shape[-2:]),
+            s.reshape(s.shape[:-4] + (-1,) + s.shape[-2:]),
         )
-    slots = (dest_page[:, None] * page + p_idx[None, :]).reshape(-1)
-    vals = pool_half[page_ids]
-    return slab_half.at[row, slots].set(
-        vals.reshape((-1,) + vals.shape[2:]), mode="drop"
-    )
+    v = pool_half[ids]
+    return v.reshape(v.shape[:-4] + (-1,) + v.shape[-2:])
+
+
+def pool_chunk(pool_half, tables, i, pages_per_chunk: int):
+    """One attention chunk's KV read THROUGH the page tables: pages
+    ``tables[:, i*ppc : (i+1)*ppc]`` of every row -> [B, ppc*page, K, hd].
+    ``i`` may be traced (the blocked fori_loop index)."""
+    B = tables.shape[0]
+    ids = jax.lax.dynamic_slice(tables, (0, i * pages_per_chunk), (B, pages_per_chunk))
+    return gather_pool_pages(pool_half, ids)
+
+
+def pool_chunk_row(pool_half, table, i, pages_per_chunk: int):
+    """Single-row form of :func:`pool_chunk`: ``table`` [n_table] ->
+    [ppc*page, K, hd]."""
+    ids = jax.lax.dynamic_slice(table, (i * pages_per_chunk,), (pages_per_chunk,))
+    return gather_pool_pages(pool_half, ids)
+
+
+def select_kv(sel, pool_kv, slab_kv):
+    """Per-position source select of a mixed chunk: ``sel`` [..., n] True
+    takes the pool byte, False the slab byte. Pages hold the EXACT bytes the
+    copy design would have gathered into the slab, so the selected chunk is
+    byte-identical to the copied one — the bit-parity contract of the
+    zero-copy read."""
+    m = sel[..., None, None]
+    if isinstance(slab_kv, QuantizedKV):
+        return QuantizedKV(
+            jnp.where(m, pool_kv.data, slab_kv.data),
+            jnp.where(m, pool_kv.scales, slab_kv.scales),
+        )
+    return jnp.where(m, pool_kv, slab_kv)
+
+
+def virtual_row(half, pool_half, table, matched):
+    """Full virtual [S, K, hd] view of one cache row: pool bytes below
+    ``matched``, the slab row beyond. The einsum-fallback read for caches
+    too small/odd to block — it materializes the select, so the blocked
+    segmented read is the production path."""
+    S = half.shape[0]
+    pooled = gather_pool_pages(pool_half, table)[:S]
+    sel = jnp.arange(S) < matched
+    return select_kv(sel, pooled, half)
+
+
+def virtual_rows_batched(half_b, pool_half, tables, matched):
+    """Batched :func:`virtual_row`: [B, S, K, hd] virtual slab with per-row
+    page tables and matched lengths."""
+    S = half_b.shape[1]
+    pooled = gather_pool_pages(pool_half, tables)[:, :S]
+    sel = jnp.arange(S)[None, :] < matched[:, None]
+    return select_kv(sel, pooled, half_b)
 
 
 def publish_row_pages(pool_half, slab_half, row, src_page, page_ids, page: int):
@@ -411,29 +461,6 @@ def fused_put_row(slab_leaf, row_leaf, row):
             ),
         )
     return jax.lax.dynamic_update_slice(slab_leaf, row_leaf[:, None], (0, row, 0, 0, 0))
-
-
-def fused_gather_pages(leaf, pool_k, pool_v, page_ids, dest_page, row, page: int):
-    """The fused-slab form of :func:`gather_pages_to_row`: both pool halves'
-    pages land in slab row ``row`` with one scatter (per-slot drop at
-    ceil(S/page), same pad contract)."""
-    p_idx = jnp.arange(page)
-    slots = (dest_page[:, None] * page + p_idx[None, :]).reshape(-1)
-    if isinstance(leaf, QuantizedKV):
-        vals = jnp.stack([pool_k.data[page_ids], pool_v.data[page_ids]])
-        scal = jnp.stack([pool_k.scales[page_ids], pool_v.scales[page_ids]])
-        return QuantizedKV(
-            leaf.data.at[:, row, slots].set(
-                vals.reshape((2, -1) + vals.shape[3:]), mode="drop"
-            ),
-            leaf.scales.at[:, row, slots].set(
-                scal.reshape((2, -1) + scal.shape[3:]), mode="drop"
-            ),
-        )
-    vals = jnp.stack([pool_k[page_ids], pool_v[page_ids]])
-    return leaf.at[:, row, slots].set(
-        vals.reshape((2, -1) + vals.shape[3:]), mode="drop"
-    )
 
 
 def scores_einsum_verify(qg: jax.Array, keys, prec) -> jax.Array:

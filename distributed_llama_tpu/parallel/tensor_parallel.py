@@ -164,6 +164,12 @@ CACHE_SPEC_LAYER = P(None, "tp", None)  # per-layer (keys, values) tuples of [S,
 # batched slab cache (engine.batch): per-layer (keys, values) tuples of
 # [B, S, K, hd] — batch and sequence replicated, KV heads sharded
 BATCH_CACHE_SPEC_LAYER = P(None, None, "tp", None)
+# prefix-cache page pool (engine.prefix_cache): per-layer (keys, values)
+# halves of [P, page, K, hd] — pages and positions replicated, KV heads
+# sharded exactly like the slab, so each shard's paged attention reads ITS
+# OWN pool half through the (replicated) page tables with the same local
+# program as the single-chip path
+POOL_SPEC_LAYER = P(None, None, "tp", None)
 
 
 def place_params(host_params, specs, mesh) -> Any:
@@ -704,4 +710,176 @@ class TensorParallelForward(TransferProbeMixin):
         return jitted(
             params, jnp.asarray(tokens), slab, jnp.int32(row), jnp.int32(pos),
             jnp.int32(n_real),
+        )
+
+    # ------------------------------------------------------------------
+    # Sharded prefix-cache page pool (engine.prefix_cache, zero-copy paged
+    # attention): per-shard [P, page, K/tp, hd] pool halves mirror the slab
+    # sharding, page tables/matched lengths are replicated host indices, and
+    # every paged read/publish runs the same local program family as the
+    # single-chip backend inside shard_map. PR 4 deferred this — the copy
+    # design needed per-shard gather programs; zero-copy needs none.
+    # ------------------------------------------------------------------
+
+    def init_page_pool(self, n_pages: int, page: int, dtype=jnp.float32):
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        if not self.layered:
+            raise ValueError("the sharded page pool requires the layered layout")
+        cfg = self.cfg
+        shape = (n_pages, page, cfg.n_kv_heads, cfg.head_size)
+        sharding = NamedSharding(self.mesh, POOL_SPEC_LAYER)
+
+        def zeros(gshape, dt):
+            local = np.zeros(gshape[:2] + (gshape[2] // self.tp,) + gshape[3:], dt)
+            return jax.make_array_from_callback(gshape, sharding, lambda idx: local)
+
+        return [
+            (kvc.init_half(shape, dtype, zeros=zeros),
+             kvc.init_half(shape, dtype, zeros=zeros))
+            for _ in range(cfg.n_layers)
+        ]
+
+    def _pool_spec(self):
+        return [(POOL_SPEC_LAYER, POOL_SPEC_LAYER)] * self.cfg.n_layers
+
+    def _publish_pages_jitted(self):
+        key = ("publish_pages",)
+        cached = self._chunk_cache.get(key)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * self.cfg.n_layers
+
+        def fn(slab, pool, page_ids, src_page, row):
+            # per-shard publish of the local KV-head slice: the page size is
+            # static from the local pool half's shape
+            return [
+                (
+                    kvc.publish_row_pages(pk, k, row, src_page, page_ids, pk.shape[1]),
+                    kvc.publish_row_pages(pv, v, row, src_page, page_ids, pv.shape[1]),
+                )
+                for (k, v), (pk, pv) in zip(slab, pool)
+            ]
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(batch_cache_spec, self._pool_spec(), P(), P(), P()),
+            out_specs=self._pool_spec(),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(1,))
+        self._chunk_cache[key] = jitted
+        return jitted
+
+    def publish_pages(self, slab, pool, page_ids, src_page, row):
+        """Copy slab row ``row``'s completed prefill pages into pool pages
+        ``page_ids`` on every shard (each shard moves its own KV-head
+        slice). The donated pool aliases in place; the slab is read-only."""
+        jitted = self._publish_pages_jitted()
+        return jitted(
+            slab, pool, jnp.asarray(page_ids), jnp.asarray(src_page),
+            jnp.int32(row),
+        )
+
+    def _batched_chunk_paged_jitted(self, n_steps: int):
+        key = ("batched_chunk_paged", n_steps)
+        cached = self._chunk_cache.get(key)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.models import sampling
+
+        cfg = self.cfg
+        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+
+        def fn(params, first_tokens, cache, pool, pos, active, temperature,
+               topp, keys, tables, matched):
+            return sampling.batched_decode_scan(
+                cfg, params, first_tokens, cache, pos, active, keys, n_steps,
+                temperature, topp, axis_name="tp",
+                paged=(pool, tables, matched),
+            )
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), batch_cache_spec, self._pool_spec(),
+                      P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), batch_cache_spec, P()),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._chunk_cache[key] = jitted
+        return jitted
+
+    def batched_decode_chunk_paged(
+        self, params, first_tokens, cache, pool, pos, active, n_steps,
+        temperature, topp, keys, tables, matched,
+    ):
+        """One batched decode chunk with zero-copy prefix aliasing under
+        TP: each shard's attention reads its pool half through the
+        replicated page tables for positions below ``matched`` and its slab
+        rows beyond — the sharded form of
+        ``sampling.decode_chunk_batched_paged``."""
+        jitted = self._batched_chunk_paged_jitted(int(n_steps))
+        return jitted(
+            params, jnp.asarray(first_tokens), cache, pool, jnp.asarray(pos),
+            jnp.asarray(active), jnp.asarray(temperature), jnp.asarray(topp),
+            jnp.asarray(keys), jnp.asarray(tables), jnp.asarray(matched),
+        )
+
+    def _slab_forward_paged_jitted(self):
+        key = ("slab_forward_paged",)
+        cached = self._chunk_cache.get(key)
+        if cached is not None:
+            return cached
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        cfg = self.cfg
+        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+
+        def fn(params, tokens, slab, pool, row, pos, n_real, table, matched):
+            row_cache = [
+                (kvc.slab_take_row(k, row), kvc.slab_take_row(v, row))
+                for k, v in slab
+            ]
+            logits, new_rows = llama.forward_tokens(
+                cfg, params, tokens, row_cache, pos, axis_name="tp",
+                n_real=n_real, paged=(pool, table, matched),
+            )
+            if logits.shape[-1] != cfg.vocab_size:
+                logits = jax.lax.all_gather(logits, "tp", axis=1, tiled=True)
+            new_slab = [
+                (kvc.slab_put_row(k, nk, row), kvc.slab_put_row(v, nv, row))
+                for (k, v), (nk, nv) in zip(slab, new_rows)
+            ]
+            return logits, new_slab
+
+        mapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), batch_cache_spec, self._pool_spec(),
+                      P(), P(), P(), P(), P()),
+            out_specs=(P(), batch_cache_spec),
+            check_vma=False,
+        )
+        jitted = jax.jit(mapped, donate_argnums=(2,))
+        self._chunk_cache[key] = jitted
+        return jitted
+
+    def slab_forward_paged(
+        self, params, tokens, slab, pool, row: int, pos: int, n_real: int,
+        table, matched,
+    ):
+        """:meth:`slab_forward` with zero-copy prefix aliasing: the row's
+        suffix prefill attends over pool pages for positions below
+        ``matched`` (each shard reading its own half) and the slab row
+        beyond."""
+        jitted = self._slab_forward_paged_jitted()
+        return jitted(
+            params, jnp.asarray(tokens), slab, pool, jnp.int32(row),
+            jnp.int32(pos), jnp.int32(n_real), jnp.asarray(table),
+            jnp.int32(matched),
         )

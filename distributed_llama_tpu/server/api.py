@@ -946,22 +946,26 @@ def main(argv=None) -> None:
         "single-chip and --tp backends, --decode device). "
         "--no-batch-decode restores independent per-request dispatches",
     )
-    # paged prefix cache (ISSUE 4, docs/PERF.md)
+    # zero-copy paged prefix cache (ISSUE 4 + 7, docs/PERF.md)
     parser.add_argument(
         "--prefix-cache", action=argparse.BooleanOptionalAction, default=True,
         help="reuse published KV pages for repeated prompt prefixes "
-        "(radix tree over token blocks; admission prefills only the "
-        "unmatched suffix — the chat system-prompt workload's TTFT win). "
-        "Requests opt out per call with body field 'cache': \"off\". "
-        "Batched serving on the single-chip backend only",
+        "(radix tree over token blocks; a hit binds the matched pages to "
+        "the row's page table — decode reads them zero-copy out of the "
+        "shared pool — and only the unmatched suffix prefills: the chat "
+        "system-prompt workload's TTFT and HBM win). Requests opt out per "
+        "call with body field 'cache': \"off\". Batched serving on the "
+        "single-chip and --tp backends",
     )
     parser.add_argument(
         "--kv-pages", type=int, default=None,
-        help="page-pool HBM budget in pages for --prefix-cache (default "
-        "--parallel x seq_len/page pages — roughly ONE extra KV slab of "
-        "HBM; size explicitly on deployments near the memory limit, 0 "
-        "disables the prefix cache); the LRU evictor reclaims "
-        "unreferenced chains beyond it",
+        help="page-pool HBM budget in pages for --prefix-cache. With "
+        "zero-copy aliasing the pool is the PRIMARY store of cached "
+        "prefixes (rows hold no duplicates), so the default is "
+        "--parallel x ceil(seq_len/page) plus 25%% headroom; a pool "
+        "smaller than one slab's worth warns (concurrent long prompts "
+        "contend for pinned pages), 0 disables the prefix cache. The LRU "
+        "evictor reclaims unreferenced chains beyond the budget",
     )
     parser.add_argument(
         "--kv-page-size", type=int, default=64,

@@ -325,6 +325,25 @@ class PrefixCacheInstruments:
             "KV pages currently held by the radix tree (the pool size "
             "--kv-pages bounds this; free = pool - this)",
         )
+        self.bytes = gauge(
+            "dllama_prefix_cache_bytes",
+            "Logical KV bytes held by the radix tree's pool pages (pages "
+            "gauge x per-page bytes across all layers and both halves) — "
+            "with zero-copy aliasing this is the ONLY resident copy of "
+            "cached prefixes",
+        )
+        self.pinned_pages = gauge(
+            "dllama_prefix_cache_pinned_pages",
+            "Pool pages ref-pinned against eviction — held for the "
+            "lifetime of rows reading them zero-copy through their page "
+            "tables (plus publishes in flight)",
+        )
+        self.copy_bytes_saved = counter(
+            "dllama_prefix_cache_copy_bytes_saved_total",
+            "HBM copy traffic avoided by zero-copy paged attention: bytes "
+            "the copy design would have gathered into the slab row per "
+            "prefix hit (matched pages x per-page bytes)",
+        )
         self.matched_tokens = histogram(
             "dllama_prefix_cache_matched_tokens",
             "Prompt tokens satisfied from the prefix cache per hit "

@@ -48,7 +48,9 @@ def decode_tokens(stream, prompt, temp, topp, seed, n, prefix_enabled=None):
 
 
 # ---------------------------------------------------------------------------
-# Page-granular kv_cache ops: publish → gather must restore the exact bytes
+# Page-granular kv_cache ops: publish must store the exact row bytes, and the
+# zero-copy paged READ (page-table gather + per-position select) must see
+# them bit-identically
 # ---------------------------------------------------------------------------
 
 
@@ -80,23 +82,25 @@ class TestPageOps:
         ids = jnp.asarray([4, 2, 0], jnp.int32)
         src = jnp.asarray([0, 1, 2], jnp.int32)
         pool = kvc.publish_row_pages(pool, slab, jnp.int32(1), src, ids, PAGE)
-        # gather them back into row 0 (a different row, zero before)
-        dest = jnp.asarray([0, 1, 2], jnp.int32)
-        slab = kvc.gather_pages_to_row(slab, pool, ids, dest, jnp.int32(0), PAGE)
 
         n = 3 * PAGE
+        # the zero-copy page-table read: the published pages, read back
+        # through the table, are the row's exact bytes
+        read = kvc.gather_pool_pages(pool, ids)
+        # and a virtual row view over an EMPTY slab row sees pool bytes
+        # below matched and the (zero) slab bytes beyond
+        n_table = -(-self.S // PAGE)
+        table = jnp.zeros(n_table, jnp.int32).at[:3].set(ids)
+        virt = kvc.virtual_row(slab[0], pool, table, jnp.int32(n))
         if isinstance(slab, kvc.QuantizedKV):
-            np.testing.assert_array_equal(
-                np.asarray(slab.data[0, :n]), reference[0][:n]
-            )
-            np.testing.assert_array_equal(
-                np.asarray(slab.scales[0, :n]), reference[1][:n]
-            )
-            # slots beyond the gathered pages stay untouched (zeros)
-            assert not np.asarray(slab.data[0, n:]).any()
+            np.testing.assert_array_equal(np.asarray(read.data), reference[0][:n])
+            np.testing.assert_array_equal(np.asarray(read.scales), reference[1][:n])
+            np.testing.assert_array_equal(np.asarray(virt.data[:n]), reference[0][:n])
+            assert not np.asarray(virt.data[n:]).any()  # slab beyond matched
         else:
-            np.testing.assert_array_equal(np.asarray(slab[0, :n]), reference[:n])
-            assert not np.asarray(slab[0, n:].astype(jnp.float32)).any()
+            np.testing.assert_array_equal(np.asarray(read), reference[:n])
+            np.testing.assert_array_equal(np.asarray(virt[:n]), reference[:n])
+            assert not np.asarray(virt[n:].astype(jnp.float32)).any()
 
     def test_roundtrip_bf16(self):
         self._roundtrip(jnp.bfloat16)
@@ -107,55 +111,45 @@ class TestPageOps:
     def test_roundtrip_quantized(self):
         self._roundtrip("i8")
 
-    def test_unaligned_seq_len_sentinel_drops_fully(self, tmp_path):
-        """Regression (review finding): with seq_len not a multiple of the
-        page size, the gather's pad sentinel must be ceil(S/page) — a floor
-        sentinel lands partially in bounds and clobbers the row tail with
-        pool page 0's bytes. Verified through the scheduler path: after a
-        prefix hit on a 3-page match (bucket-padded to 4), the row's tail
-        bytes beyond the live context are untouched."""
+    def test_unaligned_seq_len_hit_parity_and_tail_untouched(self, tmp_path):
+        """seq_len not a multiple of the page size: the virtual page table
+        covers ceil(S/page) entries and clamps its over-gather back to S —
+        a prefix hit must stream bit-identically to the cold run, and the
+        row's slab tail holds no stray writes (zero-copy admission writes
+        nothing at all below matched)."""
         spec = tiny_spec(seq_len=90)  # 90 % 4 != 0
         path = str(tmp_path / "unaligned.m")
         write_model_file(path, spec, random_tensors(spec, seed=0))
         engine = InferenceEngine(path, dtype=jnp.float32)
-        # EXACTLY 3 pool pages: publishing 3 blocks allocates page 0 too
-        # (the free list pops high-to-low), so a buggy pad write would copy
-        # page 0's REAL nonzero KV into the tail — zeros would mask the bug
         sched = BatchScheduler(
-            engine, n_rows=1, chunk=4, prefix_cache=True, kv_pages=3,
+            engine, n_rows=1, chunk=4, prefix_cache=True, kv_pages=6,
             page_size=PAGE,
         )
         s = sched.new_stream()
         prompt = list(range(1, 15))  # 14 tokens = 3 full pages + 2
-        decode_tokens(s, prompt, 0.0, 0.9, 7, 2)  # publish 3 pages
+        cold = decode_tokens(s, prompt, 0.0, 0.9, 7, 4)
         s.reset()
         tail_before = [
-            (np.asarray(k)[0, 80:].copy(), np.asarray(v)[0, 80:].copy())
-            for k, v in sched._slab
+            (np.asarray(leaf[0])[0, 80:].copy(), np.asarray(leaf[1])[0, 80:].copy())
+            for leaf in sched._slab
         ]
-        s.prefill(prompt)  # hit: gather 3 pages, bucket-padded to 4
-        for l, ((kb, vb), (k, v)) in enumerate(zip(tail_before, sched._slab)):
+        hit = decode_tokens(s, prompt, 0.0, 0.9, 7, 4)  # 3-page alias bind
+        assert hit == cold
+        for l, ((kb, vb), leaf) in enumerate(zip(tail_before, sched._slab)):
             np.testing.assert_array_equal(
-                np.asarray(k)[0, 80:], kb, err_msg=f"layer {l} keys tail"
+                np.asarray(leaf[0])[0, 80:], kb, err_msg=f"layer {l} keys tail"
             )
             np.testing.assert_array_equal(
-                np.asarray(v)[0, 80:], vb, err_msg=f"layer {l} values tail"
+                np.asarray(leaf[1])[0, 80:], vb, err_msg=f"layer {l} values tail"
             )
 
     def test_padded_entries_drop(self):
-        """Out-of-bounds dest pages (gather) and page ids (publish) are the
-        bucket-padding contract: they must write NOTHING."""
+        """Out-of-bounds page ids (publish) are the bucket-padding
+        contract: they must write NOTHING; out-of-bounds page-table
+        entries (the paged read) clamp and are masked by ``matched``."""
         slab = kvc.init_half((self.B, self.S, self.K, self.HD), jnp.float32)
         pool = kvc.init_page_pool_half(self.P, PAGE, self.K, self.HD, jnp.float32)
-        pool = pool + 1.0  # nonzero so a stray gather write would show
-        slab_pages = self.S // PAGE
-        got = kvc.gather_pages_to_row(
-            slab, pool,
-            jnp.asarray([0, 0], jnp.int32),
-            jnp.asarray([slab_pages, slab_pages], jnp.int32),  # both padded
-            jnp.int32(0), PAGE,
-        )
-        assert not np.asarray(got).any()
+        pool = pool + 1.0
         slab = slab + 2.0
         got_pool = kvc.publish_row_pages(
             pool, slab, jnp.int32(0),
@@ -164,6 +158,13 @@ class TestPageOps:
             PAGE,
         )
         np.testing.assert_array_equal(np.asarray(got_pool), np.asarray(pool))
+        # a virtual view with matched=0 never exposes pool bytes, whatever
+        # garbage the (clamped) table gather returns
+        n_table = -(-self.S // PAGE)
+        virt = kvc.virtual_row(
+            slab[0], pool, jnp.full(n_table, 99, jnp.int32), jnp.int32(0)
+        )
+        np.testing.assert_array_equal(np.asarray(virt), np.asarray(slab[0]))
 
 
 # ---------------------------------------------------------------------------
@@ -361,10 +362,12 @@ class TestPrefixHitParity:
             build_engine(tmp_path, "pfx.m"),
         )
 
-    def test_gather_failure_releases_matched_refs(self, tmp_path, monkeypatch):
-        """A failed gather dispatch fails the request but must not leave
-        the matched chain ref-pinned (pinned pages can never be evicted —
-        the budget would silently leak away)."""
+    def test_suffix_prefill_failure_releases_alias_pins(self, tmp_path, monkeypatch):
+        """A failed suffix-prefill dispatch after a prefix hit fails the
+        request but must unwind the alias bind: the matched chain's
+        row-lifetime pins release (pinned pages can never be evicted — the
+        budget would silently leak away), the row's position resets, and
+        the next request recovers."""
         from distributed_llama_tpu.engine import batch as batch_mod
 
         engine = build_engine(tmp_path)
@@ -373,14 +376,15 @@ class TestPrefixHitParity:
         want = decode_tokens(s, PROMPT, 0.0, 0.9, 7, 8)  # publish the prefix
 
         def boom(*a, **kw):
-            raise RuntimeError("injected gather failure")
+            raise RuntimeError("injected paged prefill failure")
 
-        monkeypatch.setattr(batch_mod, "_gather_pages", boom)
+        monkeypatch.setattr(batch_mod, "_slab_prefill_single_paged", boom)
         s.reset()
-        with pytest.raises(RuntimeError, match="injected gather"):
+        with pytest.raises(RuntimeError, match="injected paged"):
             s.prefill(PROMPT)
+        assert s.matched_len == 0 and not s._alias_ids and s.pos == 0
         assert all(nd.refs == 0 for nd in sched._prefix._walk())
-        sched._prefix.check()
+        sched.check_prefix()
         monkeypatch.undo()
         assert decode_tokens(s, PROMPT, 0.0, 0.9, 7, 8) == want  # recovered
 
@@ -432,12 +436,28 @@ class TestMisconfiguration:
             s = sched.new_stream()
             assert decode_tokens(s, PROMPT, 0.0, 0.9, 7, 4)
 
-    def test_default_budget_is_one_slab(self, tmp_path):
+    def test_default_budget_is_slab_plus_headroom(self, tmp_path):
+        """With zero-copy aliasing the pool is the PRIMARY prefix store
+        (rows hold no duplicates), so the default budget is one slab's
+        worth of pages plus 25% headroom (at least one row's worth) for
+        prefixes outliving their rows."""
         engine = build_engine(tmp_path, seq_len=96)
         sched = BatchScheduler(
             engine, n_rows=2, chunk=4, prefix_cache=True, page_size=PAGE
         )
-        assert sched._prefix.capacity == 2 * (96 // PAGE)
+        slab_pages = 2 * (96 // PAGE)
+        assert sched._prefix.capacity == slab_pages + max(
+            slab_pages // 4, 96 // PAGE
+        )
+
+    def test_undersized_pool_warns_but_stays_enabled(self, tmp_path, capsys):
+        engine = build_engine(tmp_path, seq_len=96)
+        sched = BatchScheduler(
+            engine, n_rows=2, chunk=4, prefix_cache=True, page_size=PAGE,
+            kv_pages=8,  # < one slab's worth (48)
+        )
+        assert sched._prefix is not None and sched._prefix.capacity == 8
+        assert "smaller than one slab" in capsys.readouterr().out
 
 
 class TestChunkedPrefill:
