@@ -20,6 +20,7 @@ import sys
 import numpy as np
 
 from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.stats import median, median_by
 from distributed_llama_tpu.telemetry import Stopwatch
 
 
@@ -229,7 +230,7 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
         sw = Stopwatch()
         np.asarray(jnp.zeros(4) + 1)
         rt_samples.append(sw.elapsed_ms())
-    rt_ms = sorted(rt_samples)[2]
+    rt_ms = median(rt_samples)
 
     with telemetry.trace_span("bench_prefill_cold", tokens=prefill_len):
         sw = Stopwatch()
@@ -248,7 +249,7 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
             logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((1 + i) * prefill_len))
             np.asarray(logits[-1])
             warm_times.append(sw.elapsed_ms())
-    prefill_warm_ms = sorted(warm_times)[1]
+    prefill_warm_ms = median(warm_times)
 
     # ON-DEVICE prefill: K chained dispatches, ONE fence, minus one round
     # trip — the number the hardware actually delivers (the warm single
@@ -264,7 +265,7 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
                 logits, cache = fwd(cfg, params, prompt, cache, jnp.int32((i % 4) * prefill_len))
             np.asarray(logits[-1])
             dev_times.append((sw.elapsed_ms() - rt_ms) / K)
-    prefill_device_ms = max(sorted(dev_times)[1], 1e-3)
+    prefill_device_ms = max(median(dev_times), 1e-3)
     prefill_tps = prefill_len / prefill_device_ms * 1000.0
 
     token = jnp.int32(np.argmax(np.asarray(logits[-1])))
@@ -321,8 +322,8 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
             pos += chunk
         np.asarray(toks)  # the last dispatched chunk must finish in-window
         user_runs.append(n_chunks * chunk / sw.elapsed_s())
-    tps = sorted(single_runs)[1]
-    user_tps = sorted(user_runs)[1]
+    tps = median(single_runs)
+    user_tps = median(user_runs)
 
     # secondary: host-sampled stepwise decode (the reference's exact regime,
     # pays a host<->device round trip per token); warm the 1-token shape first
@@ -439,7 +440,7 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
                     last = toks
             np.asarray(last)  # fence: every dispatched chunk must finish
             single_runs.append(B * n_rounds * chunk / sw.elapsed_s())
-    interleaved_tps = sorted(single_runs)[1]
+    interleaved_tps = median(single_runs)
     del caches
     gc.collect()
 
@@ -476,7 +477,7 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
                 pos = pos + chunk
             np.asarray(toks_r)
             batch_runs.append(B * n_rounds * chunk / sw.elapsed_s())
-    batched_tps = sorted(batch_runs)[1]
+    batched_tps = median(batch_runs)
 
     speedup = batched_tps / interleaved_tps if interleaved_tps else 0.0
     return {
@@ -576,7 +577,7 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
     for rep in range(3):
         cache, key, tps, plain_out = plain_round(cache, key, "bench_spec_plain", rep)
         plain_runs.append(tps)
-    plain_tps = sorted(plain_runs)[1]
+    plain_tps = median(plain_runs)
 
     # ---- speculative decode (one verify forward per step) ----------------
     drafted_total = accepted_total = steps_total = 0
@@ -619,7 +620,7 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
             with telemetry.trace_span("bench_spec_verify", rep=rep, k=k):
                 cache, tps, spec_out = spec_round(cache, timed=True)
             spec_runs.append(tps)
-        spec_tps = sorted(spec_runs)[1]
+        spec_tps = median(spec_runs)
     else:
         # --spec 0: the flag gates the speculative path off entirely, so the
         # "spec" arm is a SECOND independent plain measurement — a genuine
@@ -631,7 +632,7 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
                 cache, key, "bench_spec_plain_rerun", rep
             )
             rerun_runs.append(tps)
-        spec_tps = sorted(rerun_runs)[1]
+        spec_tps = median(rerun_runs)
     acceptance = accepted_total / drafted_total if drafted_total else 0.0
     greedy_match = (
         plain_out is not None and spec_out is not None
@@ -788,7 +789,7 @@ def run_chaos(b: int = 4, n_tokens: int = 64, chunk: int = 8) -> dict:
     for rep in range(3):
         with telemetry.trace_span("bench_chaos_clean", b=b, rep=rep):
             clean_rounds.append(run_round(streams))
-    clean = sorted(clean_rounds, key=lambda r: r["tps"])[1]
+    clean = median_by(clean_rounds, key=lambda r: r["tps"])
     # failure/recovery counts are SUMS over the same 3 rounds on both sides
     # (the tps medians stay medians) — summing chaos but not clean would
     # make the report compare incommensurable numbers
@@ -810,7 +811,7 @@ def run_chaos(b: int = 4, n_tokens: int = 64, chunk: int = 8) -> dict:
                 chaos_rounds.append(run_round(streams2))
     finally:
         faults.clear()
-    chaos = sorted(chaos_rounds, key=lambda r: r["tps"])[1]
+    chaos = median_by(chaos_rounds, key=lambda r: r["tps"])
     chaos["failed"] = sum(r["failed"] for r in chaos_rounds)
     chaos["recovered"] = sum(r["recovered"] for r in chaos_rounds)
 
@@ -922,7 +923,7 @@ def run_prefix_cache(chaos: bool = False) -> dict:
         fresh = rng.randint(1, spec.vocab_size, 64).tolist()
         with telemetry.trace_span("bench_prefix_cold", rep=r):
             cold_runs.append(ttft_ms(streams[0], fresh + tail(r), r))
-    ttft_cold = sorted(cold_runs)[1]
+    ttft_cold = median(cold_runs)
 
     # hit: publish the shared prefix once (untimed), then measure requests
     # that reuse it with distinct tails — the chat system-prompt workload
@@ -934,7 +935,7 @@ def run_prefix_cache(chaos: bool = False) -> dict:
     for r in range(3):
         with telemetry.trace_span("bench_prefix_hit", rep=r):
             hit_runs.append(ttft_ms(streams[1], shared_prefix + tail(200 + r), r))
-    ttft_hit = sorted(hit_runs)[1]
+    ttft_hit = median(hit_runs)
     hits_measured = ctr("dllama_prefix_cache_hits_total") - hits_before
     assert hits_measured >= 3, (
         "repeated-prefix requests did not hit the prefix cache"

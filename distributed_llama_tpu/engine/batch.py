@@ -182,6 +182,13 @@ class BatchStream:
         # layer: the scheduler retires an expired row BETWEEN chunks and its
         # next_token raises DeadlineExceeded (ISSUE 3)
         self.deadline: float | None = None
+        # multi-tenant serving (ISSUE 8): the serving layer labels the row
+        # with its request's tenant and priority for the lifetime of the
+        # request (cleared between requests). ``priority is not None``
+        # marks the row an active preemption candidate: preempt_below may
+        # evict it for a strictly-higher-priority arrival
+        self.tenant: str | None = None
+        self.priority: int | None = None
         # per-request prefix-cache opt-out (the API body's `cache: off`):
         # False skips BOTH the admission match and the post-prefill publish
         # for this row (ISSUE 4); serving restores True between requests
@@ -243,6 +250,8 @@ class BatchStream:
         self._fetch_error = None
         self.deadline = None
         self.prefix_cache_enabled = True
+        self.tenant = None
+        self.priority = None
         self._history = []
         self._drafter = None
         self._spec_on = False
@@ -593,6 +602,10 @@ class BatchScheduler:
         self.retry_backoff_s = float(retry_backoff_s)
         self.stall_timeout_s = stall_timeout_s
         self._faults = faults.active_plan()
+        # priority preemption (ISSUE 8): clean evictions performed by
+        # preempt_below — a plain counter so tests/loadgen read it with
+        # telemetry off (the registry's dllama_preemptions_total mirrors it)
+        self.preempted_total = 0
         if tp_engine is None:
             self._slab = llama.init_batch_cache(
                 engine.cfg, n_rows, dtype=engine.cache_dtype
@@ -742,6 +755,14 @@ class BatchScheduler:
         off = 0
         c = n
         while off < n:
+            if stream._fetch_error is not None:
+                # a preemption (or watchdog/quarantine) that landed between
+                # prefill chunks: stop dispatching this prompt — the chunk
+                # boundaries are the prefill's yield points for eviction
+                # exactly as they are for deadlines below
+                err = stream._fetch_error
+                stream._fetch_error = None
+                raise err
             if (
                 stream.deadline is not None
                 and time.monotonic() >= stream.deadline
@@ -1018,8 +1039,88 @@ class BatchScheduler:
             stream._queue.clear()
             stream._epoch += 1
             stream._joined = True
-            stream._fetch_error = None
+            if not isinstance(stream._fetch_error, faults.RowPreempted):
+                # stale errors from a previous occupancy clear; a PREEMPTION
+                # that landed between this request's prefill and its decode
+                # join must survive the join (the first next_token raises it
+                # and the request requeues). Cross-request staleness is
+                # impossible: the serving layer retracts an unconsumed
+                # preemption when each request ends (retract_preemption)
+                stream._fetch_error = None
             self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # Priority preemption (ISSUE 8): evict the lowest-priority active row
+    # for a strictly-higher-priority arrival. The victim is retired with a
+    # typed RowPreempted exactly like a deadline expiry — between chunks,
+    # co-batched rows untouched — and the serving layer REQUEUES it: its
+    # admission prefill published its prefix pages, so the re-run prefills
+    # through the prefix cache and (same seed) streams bit-identically to
+    # an uncontended run.
+    # ------------------------------------------------------------------
+
+    def preempt_below(self, priority: int) -> bool:
+        """Evict the lowest-priority active row whose priority is strictly
+        below ``priority`` (ties: least-progressed row — the cheapest
+        restart). Returns True when a row was cleanly evicted. The
+        ``engine.preempt`` chaos site fires on the chosen victim: an
+        injected raise QUARANTINES it (typed failure, survivors
+        bit-identical) instead of requeueing it.
+
+        Page pins are NOT released here, on purpose: the victim may be
+        mid-admission-prefill, and dropping its alias table under the
+        cond while its final suffix chunk is still dispatching would make
+        that chunk attend over never-written slab positions (matched
+        reads 0) and then publish the corrupted KV into the shared radix
+        tree. Leaving the table intact keeps every in-flight dispatch —
+        and any subsequent publish — byte-correct; the pins release
+        through the victim's own unwind exactly like a deadline expiry's:
+        the prefill-boundary raise unwinds the alias bind in
+        _prefill_row, and a mid-decode victim's pins fall at the row's
+        next reset/_match_alias (stale-alias reclaim)."""
+        engine = self.engine
+        with self._cond:
+            victims = [
+                s for s in self._streams
+                if s.priority is not None
+                and s.priority < priority
+                and s._fetch_error is None
+            ]
+            if not victims:
+                return False
+            victim = min(victims, key=lambda s: (s.priority, s.pos))
+            injected: Exception | None = None
+            try:
+                self._faults.fire("engine.preempt", row=victim.row)
+            except Exception as e:
+                injected = e
+            if injected is None:
+                err: BaseException = faults.RowPreempted(
+                    f"row {victim.row} (tenant {victim.tenant!r}, priority "
+                    f"{victim.priority}) preempted by a priority-{priority} "
+                    "arrival; requeued through fair admission"
+                )
+                self.preempted_total += 1
+                engine._tel.preemptions.inc()
+            else:
+                err = faults.RowQuarantined(
+                    "batch row retired: preemptive eviction failed for "
+                    "this row"
+                )
+                err.__cause__ = injected
+                engine._tel.rows_quarantined.inc()
+            victim._fetch_error = err
+            self._cond.notify_all()
+            return injected is None
+
+    def retract_preemption(self, stream: BatchStream) -> None:
+        """Drop an UNCONSUMED preemption marker at request end (the victim
+        finished before its next_token could raise): without this, a
+        RowPreempted surviving _join could leak into the row's next
+        request and requeue it spuriously."""
+        with self._cond:
+            if isinstance(stream._fetch_error, faults.RowPreempted):
+                stream._fetch_error = None
 
     def _leave(self, stream: BatchStream) -> None:
         with self._cond:

@@ -100,6 +100,12 @@ class EngineStream:
         # slot wears; only the batch scheduler's paged prefix cache consumes
         # it — an independent EngineStream has no shared page pool to reuse
         self.prefix_cache_enabled = True
+        # multi-tenant labels, surface parity with BatchStream (ISSUE 8):
+        # the serving layer stamps them per request; only the batch
+        # scheduler consumes them (preempt_below) — independent streams
+        # have no shared rows to evict
+        self.tenant: str | None = None
+        self.priority: int | None = None
         engine._streams.append(self)
         engine._tel.active_streams.set(len(engine._streams))
 
@@ -148,6 +154,8 @@ class EngineStream:
         self._pending_prefill_entry = None
         self.deadline = None
         self.prefix_cache_enabled = True
+        self.tenant = None
+        self.priority = None
 
     def rollback(self, pos: int) -> None:
         """Rewind the stream to ``pos`` (prefix-cache reuse). Cache slots

@@ -35,6 +35,13 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     a ``row=`` rule quarantines ONLY the targeted row AND
                     releases its page pins (the aliased pages stay live
                     for every other row; survivors bit-identical)
+``engine.preempt``  raise during a priority preemption's eviction
+                    (engine/batch.py ``preempt_below``): the victim row is
+                    QUARANTINED instead of cleanly requeued — its request
+                    fails typed, its page pins release through the row's
+                    normal unwind, co-batched survivors stay bit-identical,
+                    and the preemptor still admits once the quarantined
+                    row's slot frees
 ``tp.transfer``     raise/delay inside the transfer probe (the engine keeps
                     its last estimate instead of dying)
 ``server.send``     raise ``BrokenPipeError`` from the SSE chunk writer
@@ -102,6 +109,15 @@ class StallTimeout(RuntimeError):
     the batch cleanly (the hung fetch's late result is discarded)."""
 
 
+class RowPreempted(RuntimeError):
+    """This request's batch row was evicted by a higher-priority arrival
+    (engine/batch.py ``preempt_below``). NOT a failure: the serving layer
+    catches it and REQUEUES the request through weighted-fair admission —
+    the re-run prefills through the prefix cache's published pages and,
+    at the same seed, streams bit-identically to an uncontended run
+    (already-sent SSE deltas are suppressed on replay)."""
+
+
 KINDS = ("raise", "nan", "delay", "hang", "disconnect")
 
 # The registered injection sites — the single source of truth the static
@@ -118,6 +134,7 @@ SITES = (
     "engine.fetch",
     "engine.spec_verify",
     "engine.paged_attn",
+    "engine.preempt",
     "tp.transfer",
     "server.send",
 )

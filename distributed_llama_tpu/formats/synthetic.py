@@ -68,3 +68,39 @@ def write_synthetic_model(path: str, spec: ModelSpec, seed: int = 0) -> str:
     """One-call helper: random weights for ``spec`` written to ``path``."""
     write_model_file(path, spec, random_tensors(spec, seed=seed))
     return path
+
+
+# the tiniest template the ChatTemplate sniffer classifies as CHATML
+# (tokenizer.detect_chat_template matches on the "<|im_start|>" substring)
+SYNTHETIC_CHAT_TEMPLATE = (
+    "{{bos_token}}{% for m in messages %}<|im_start|>...{% endfor %}"
+)
+
+
+def synthetic_tokenizer_data():
+    """A sentencepiece-style synthetic vocab with full byte fallback:
+    <unk>/<s>/</s>, 256 byte tokens, a few merge-scored words — every
+    string encodes (1 token per byte for novel text), so synthetic prompts
+    need no real tokenizer. The chatml template makes it chat-servable:
+    the one shared tokenizer behind the loadgen self-host server
+    (loadgen/selfhost.py) and CI-scale serving smokes."""
+    from distributed_llama_tpu.formats.tokenizer_file import TokenizerData
+
+    vocab: list[bytes] = [b"<unk>", b"<s>", b"</s>"]
+    scores: list[float] = [0.0, 0.0, 0.0]
+    for b in range(256):
+        vocab.append(f"<0x{b:02X}>".encode())
+        scores.append(0.0)
+    for tok, score in (
+        (b" ", -1.0), (b"h", -2.0), (b"e", -2.0), (b"l", -2.0),
+        (b"o", -2.0), (b"he", -3.0), (b"ll", -4.0), (b"hell", -5.0),
+        (b"hello", -6.0), (b" hello", -7.0), (b"w", -2.0), (b"r", -2.0),
+        (b"d", -2.0), (b"wo", -3.0), (b"wor", -4.0), (b"worl", -5.0),
+        (b"world", -6.5), (b" world", -7.5),
+    ):
+        vocab.append(tok)
+        scores.append(score)
+    return TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=2,
+        chat_template=SYNTHETIC_CHAT_TEMPLATE,
+    )

@@ -1,0 +1,39 @@
+"""Production traffic harness: a deterministic, seeded load generator that
+drives the real HTTP server (`server/api.py`) with contended, bursty,
+multi-tenant workloads and reports tail latency — the harness every perf
+PR is judged against (ISSUE 8; ROADMAP open item 5).
+
+Everything PRs 1–7 built (batching, fault tolerance, prefix caching,
+speculative decode) was measured median-of-a-quiet-loop; this package is
+where "fast" gets a p99 and "robust" gets goodput-under-SLO evidence:
+
+* :mod:`~distributed_llama_tpu.loadgen.workload` — seeded workload specs:
+  Zipf-distributed shared prompt prefixes (exercising the radix prefix
+  cache), mixed prompt/output lengths, open-loop Poisson / bursty /
+  uniform arrivals, per-tenant shares, priorities, deadlines and SLOs.
+  ``build_schedule`` is a pure function of (spec, seed): same seed → the
+  byte-identical arrival schedule, fingerprinted for replay proofs.
+* :mod:`~distributed_llama_tpu.loadgen.runner` — the open-loop HTTP
+  driver: requests fire at their scheduled instants regardless of
+  completions (closed-loop clients hide queueing collapse), stream SSE,
+  and record TTFT / TPOT / E2E per request.
+* :mod:`~distributed_llama_tpu.loadgen.report` — the SLO report:
+  per-tenant and aggregate p50/p90/p99, goodput-under-SLO, 429/504/
+  preemption/quarantine counts scraped from ``/metrics`` (before/after
+  deltas), plus fairness / isolation / greedy-consistency checks.
+* :mod:`~distributed_llama_tpu.loadgen.selfhost` — an in-process server
+  on a tiny synthetic model for CI-scale runs, composable with a
+  ``--faults`` chaos plan (chaos-under-load).
+
+CLI: ``python -m distributed_llama_tpu.loadgen --help``; workload and
+report formats: docs/SERVING.md.
+"""
+
+from distributed_llama_tpu.loadgen.report import build_report  # noqa: F401
+from distributed_llama_tpu.loadgen.runner import run_schedule  # noqa: F401
+from distributed_llama_tpu.loadgen.workload import (  # noqa: F401
+    TenantLoad,
+    Workload,
+    build_schedule,
+    schedule_fingerprint,
+)

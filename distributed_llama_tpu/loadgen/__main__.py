@@ -1,0 +1,227 @@
+"""``python -m distributed_llama_tpu.loadgen`` — drive a server, print the
+SLO report.
+
+Examples (docs/SERVING.md has the full walkthrough):
+
+  # CI-scale zero-to-report: tiny synthetic model, in-process server
+  JAX_PLATFORMS=cpu python -m distributed_llama_tpu.loadgen --self-host \\
+      --requests 24 --rate 20 \\
+      --tenants "gold:share=0.3,priority=5,slo_ttft_ms=5000;free:share=0.7" \\
+      --assert --out loadgen-report.json
+
+  # chaos-under-load: same run with a fault plan on the server side
+  ... --self-host --faults "batch.row:kind=nan,row=1,after=2,count=1"
+
+  # two-phase tenant-isolation proof for tenant "gold"
+  ... --self-host --isolation gold
+
+  # an external server (the report scrapes <url>/metrics for deltas)
+  python -m distributed_llama_tpu.loadgen --url http://127.0.0.1:9990
+
+Exit codes: 0 = report produced (all asserted checks passed), 1 = a
+``--assert``/``--isolation`` check failed, 2 = the run itself failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from distributed_llama_tpu.loadgen import report as rep
+from distributed_llama_tpu.loadgen import runner, workload
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_llama_tpu.loadgen",
+        description="deterministic multi-tenant load generator for the "
+        "dllama API server (docs/SERVING.md)",
+    )
+    tgt = p.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--url", type=str, help="base URL of a running server")
+    tgt.add_argument(
+        "--self-host", action="store_true",
+        help="serve a tiny synthetic model in-process (CI-scale; "
+        "JAX_PLATFORMS=cpu recommended)",
+    )
+    # workload shape (defaults = the CI smoke)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=16.0, help="arrival rate rps")
+    p.add_argument(
+        "--arrival", choices=("poisson", "burst", "uniform"),
+        default="poisson",
+    )
+    p.add_argument("--burst-size", type=int, default=8)
+    p.add_argument("--burst-period-s", type=float, default=1.0)
+    p.add_argument("--prefixes", type=int, default=4,
+                   help="Zipf-shared prompt prefix pool size")
+    p.add_argument("--zipf-s", type=float, default=1.1)
+    p.add_argument("--prefix-chars", type=int, default=48)
+    p.add_argument("--suffixes", type=int, default=6)
+    p.add_argument("--suffix-chars", type=int, default=12)
+    p.add_argument(
+        "--tenants", type=str, default=None,
+        help="tenant mix: 'name:share=S,priority=P,deadline_ms=D,"
+        "slo_ttft_ms=T,slo_e2e_ms=E,max_tokens=M;...' (default: one "
+        "'default' tenant)",
+    )
+    # driving
+    p.add_argument("--max-inflight", type=int, default=128)
+    p.add_argument("--timeout-s", type=float, default=120.0)
+    p.add_argument(
+        "--warmup", type=int, default=3,
+        help="sequential unmeasured requests before the open loop "
+        "(jit compiles land outside the measured window)",
+    )
+    # self-host server knobs
+    p.add_argument("--parallel", type=int, default=4,
+                   help="self-host serving slots (batch rows)")
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument(
+        "--server-tenants", type=str, default=None,
+        help="self-host --tenants spec (weights/priorities/queues); "
+        "defaults to the workload tenants at weight 1",
+    )
+    p.add_argument(
+        "--faults", type=str, default=None,
+        help="self-host chaos plan spec (docs/ROBUSTNESS.md) — "
+        "chaos-under-load composition",
+    )
+    p.add_argument("--faults-seed", type=int, default=0)
+    p.add_argument("--no-preempt", action="store_true")
+    p.add_argument(
+        "--admission-queue", type=int, default=None,
+        help="self-host admission queue bound (default 2x --parallel; "
+        "raise it to measure queueing latency instead of 429 shedding)",
+    )
+    # report / checks
+    p.add_argument("--out", type=str, default=None, help="report JSON path")
+    p.add_argument(
+        "--assert", dest="assert_checks", action="store_true",
+        help="exit 1 unless fairness + consistency checks pass",
+    )
+    p.add_argument(
+        "--isolation", type=str, default=None, metavar="TENANT",
+        help="two-phase isolation proof: run TENANT's arrivals alone, "
+        "then the full mix; asserts contended p99 TTFT <= bound x "
+        "uncontended + slack",
+    )
+    p.add_argument("--isolation-bound", type=float, default=10.0)
+    p.add_argument("--isolation-slack-ms", type=float, default=1000.0)
+    return p
+
+
+def make_workload(args) -> workload.Workload:
+    return workload.Workload(
+        seed=args.seed,
+        n_requests=args.requests,
+        rate_rps=args.rate,
+        arrival=args.arrival,
+        burst_size=args.burst_size,
+        burst_period_s=args.burst_period_s,
+        n_prefixes=args.prefixes,
+        zipf_s=args.zipf_s,
+        prefix_chars=args.prefix_chars,
+        n_suffixes=args.suffixes,
+        suffix_chars=args.suffix_chars,
+        tenants=workload.parse_tenant_loads(args.tenants),
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    w = make_workload(args)
+    schedule = workload.build_schedule(w)
+    fingerprint = workload.schedule_fingerprint(schedule)
+    # deterministic-replay proof: a second independent build of the same
+    # (spec, seed) must fingerprint identically — asserted on EVERY run,
+    # it is cheap and it is the contract
+    replay_ok = (
+        workload.schedule_fingerprint(workload.build_schedule(w))
+        == fingerprint
+    )
+    host = None
+    if args.self_host:
+        from distributed_llama_tpu.loadgen.selfhost import start_selfhost
+
+        host = start_selfhost(
+            parallel=args.parallel,
+            seq_len=args.seq_len,
+            tenants=args.server_tenants,
+            preempt=not args.no_preempt,
+            faults_spec=args.faults,
+            faults_seed=args.faults_seed,
+            admission_queue=args.admission_queue,
+        )
+        url = host.url
+        print(f"self-hosted server at {url}", file=sys.stderr)
+    else:
+        url = args.url.rstrip("/")
+    try:
+        if args.warmup > 0:
+            warmed = runner.warm_server(
+                url, schedule, n=args.warmup, timeout_s=max(args.timeout_s, 300.0)
+            )
+            print(f"warmup: {warmed}/{args.warmup} completed", file=sys.stderr)
+        if host is not None:
+            # chaos determinism: rule gates (after/count) must count hits of
+            # the MEASURED window, not warmup's — rewind the plan counters
+            host.reset_faults()
+        solo_results = None
+        if args.isolation:
+            solo = [r for r in schedule if r.tenant == args.isolation]
+            if not solo:
+                print(
+                    f"isolation tenant {args.isolation!r} has no arrivals",
+                    file=sys.stderr,
+                )
+                return 2
+            # phase 1: the probe tenant alone, same instants (uncontended)
+            solo_results, _ = runner.run_schedule(
+                url, _reindexed(solo), max_inflight=args.max_inflight,
+                timeout_s=args.timeout_s,
+            )
+        before = rep.scrape_metrics(url)
+        results, wall_s = runner.run_schedule(
+            url, schedule, max_inflight=args.max_inflight,
+            timeout_s=args.timeout_s,
+        )
+        after = rep.scrape_metrics(url)
+        report = rep.build_report(
+            w, schedule, results, wall_s, fingerprint, replay_ok,
+            metrics_before=before, metrics_after=after,
+        )
+        if solo_results is not None:
+            report["checks"]["isolation"] = rep.check_isolation(
+                args.isolation, solo_results, results,
+                bound=args.isolation_bound, slack_ms=args.isolation_slack_ms,
+            )
+        text = rep.dump_report(report, args.out)
+        print(text)
+        if not replay_ok:
+            print("FATAL: schedule replay fingerprint mismatch", file=sys.stderr)
+            return 2
+        if args.assert_checks or args.isolation:
+            bad = rep.failed_checks(report)
+            if bad:
+                for v in bad:
+                    print(f"CHECK FAILED: {v}", file=sys.stderr)
+                return 1
+            print("all checks passed", file=sys.stderr)
+        return 0
+    finally:
+        if host is not None:
+            host.stop()
+
+
+def _reindexed(subset):
+    """Re-index a schedule subset from 0 (run_schedule stores results by
+    index) without mutating the original entries."""
+    import dataclasses as dc
+
+    return [dc.replace(r, index=i) for i, r in enumerate(subset)]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

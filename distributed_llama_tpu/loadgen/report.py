@@ -1,0 +1,312 @@
+"""The SLO report: percentile summaries, goodput, server-metric deltas,
+and the machine-checked invariants (fairness / isolation / consistency).
+
+The report is ONE JSON document (docs/SERVING.md defines the shape) built
+from three inputs: the deterministic schedule, the measured per-request
+results, and a before/after scrape of the server's ``/metrics`` — so
+client-observed latency and server-side counters (preemptions,
+quarantines, 429s, prefix-cache hits) land in the same artifact and can
+be cross-checked.
+
+Checks (each returns ``{"ok": bool, "violations": [...]}``, the CI gate
+fails on any violation):
+
+* **consistency** — greedy requests with byte-identical bodies must
+  stream byte-identical content. Under a chaos plan this is the
+  no-survivor-corruption proof: quarantined/errored requests are excluded,
+  so any surviving mismatch is a real cross-request corruption.
+* **fairness** — every tenant's arrivals are fully accounted (completed +
+  rejected + deadline + errors + dropped == scheduled) and no tenant with
+  scheduled work starved to zero completions while another tenant
+  completed (the count-level starvation witness; the DRR share-convergence
+  proof is deterministic and lives in tests/test_fair_sched.py).
+* **isolation** — tenant B's contended p99 TTFT stays within
+  ``bound × uncontended + slack`` of its solo run (the two-phase
+  ``--isolation`` mode drives this).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from distributed_llama_tpu.stats import percentile, summarize
+from distributed_llama_tpu.loadgen.runner import OUTCOMES, RequestResult
+from distributed_llama_tpu.loadgen.workload import (
+    ScheduledRequest,
+    Workload,
+    scheduled_counts,
+)
+
+# server counters whose run delta lands in the report (labeled series are
+# summed per base name; absent series read as 0 — telemetry may be off)
+SERVER_COUNTERS = (
+    "dllama_preemptions_total",
+    "dllama_preempted_requeued_total",
+    "dllama_rows_quarantined_total",
+    "dllama_admission_rejected_total",
+    "dllama_deadline_exceeded_total",
+    "dllama_tenant_admitted_total",
+    "dllama_tenant_rejected_total",
+    "dllama_prefix_cache_hits_total",
+    "dllama_prefix_cache_misses_total",
+    "dllama_faults_injected_total",
+    "dllama_watchdog_stalls_total",
+)
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Minimal Prometheus text-exposition parser: ``name{labels} value``
+    lines → {series: value}. Histogram sub-series keep their suffixed
+    names; comments and blanks drop."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def scrape_metrics(url: str, timeout_s: float = 10.0) -> dict[str, float]:
+    """GET ``url``/metrics → parsed series. A scrape failure returns {}
+    (the report then shows null deltas rather than aborting the run)."""
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=timeout_s) as r:
+            return parse_prometheus(r.read().decode())
+    except OSError:
+        return {}
+
+
+def _sum_series(metrics: dict[str, float], base: str) -> float:
+    """Sum every series of ``base`` across its label sets (exact-name
+    match or ``base{...}``)."""
+    return sum(
+        v for k, v in metrics.items()
+        if k == base or k.startswith(base + "{")
+    )
+
+
+def metric_deltas(
+    before: dict[str, float], after: dict[str, float],
+    names=SERVER_COUNTERS,
+) -> dict[str, float]:
+    return {
+        n: round(_sum_series(after, n) - _sum_series(before, n), 3)
+        for n in names
+    }
+
+
+# ----------------------------------------------------------------------
+# Aggregation
+# ----------------------------------------------------------------------
+
+
+def _summarize_group(
+    results: list[RequestResult], wall_s: float,
+    slo_ttft_ms: float | None = None, slo_e2e_ms: float | None = None,
+) -> dict:
+    counts = {o: 0 for o in OUTCOMES}
+    for r in results:
+        counts[r.outcome] += 1
+    completed = [r for r in results if r.outcome == "completed"]
+    good = [
+        r for r in completed
+        if (slo_ttft_ms is None or (r.ttft_ms or 0) <= slo_ttft_ms)
+        and (slo_e2e_ms is None or (r.e2e_ms or 0) <= slo_e2e_ms)
+    ]
+    out = {
+        "scheduled": len(results),
+        "counts": counts,
+        "ttft_ms": summarize([r.ttft_ms for r in completed if r.ttft_ms is not None]),
+        "tpot_ms": summarize([r.tpot_ms for r in completed if r.tpot_ms is not None]),
+        "e2e_ms": summarize([r.e2e_ms for r in completed if r.e2e_ms is not None]),
+        "sched_lag_ms": summarize([r.sched_lag_ms for r in results]),
+        "tokens_streamed": sum(r.n_deltas for r in completed),
+        # goodput: completions INSIDE their SLO targets, as a rate and as
+        # a fraction of everything that was scheduled (not of completions —
+        # shed load must hurt the number, that is its job)
+        "goodput_rps": round(len(good) / wall_s, 3) if wall_s > 0 else 0.0,
+        "goodput_under_slo": (
+            round(len(good) / len(results), 4) if results else 0.0
+        ),
+    }
+    # the observed Retry-After values across 429/503 responses: more than
+    # one distinct value is the visible proof the jitter satellite works
+    # (a fixed header re-synchronizes every rejected client's retry)
+    ras = sorted({
+        r.retry_after for r in results if r.retry_after is not None
+    })
+    if ras:
+        out["retry_after_s_seen"] = ras
+    if slo_ttft_ms is not None or slo_e2e_ms is not None:
+        out["slo"] = {"ttft_ms": slo_ttft_ms, "e2e_ms": slo_e2e_ms}
+    return out
+
+
+def build_report(
+    workload: Workload,
+    schedule: list[ScheduledRequest],
+    results: list[RequestResult],
+    wall_s: float,
+    fingerprint: str,
+    replay_verified: bool,
+    metrics_before: dict[str, float] | None = None,
+    metrics_after: dict[str, float] | None = None,
+) -> dict:
+    """Assemble the SLO report (docs/SERVING.md "Report format")."""
+    slos = {t.name: (t.slo_ttft_ms, t.slo_e2e_ms) for t in workload.tenants}
+    tenants: dict[str, dict] = {}
+    for name in sorted({r.tenant for r in results}):
+        rs = [r for r in results if r.tenant == name]
+        ttft, e2e = slos.get(name, (None, None))
+        tenants[name] = _summarize_group(rs, wall_s, ttft, e2e)
+    report = {
+        "workload": workload.spec_dict(),
+        "schedule": {
+            "fingerprint": fingerprint,
+            "replay_verified": replay_verified,
+            "n_requests": len(schedule),
+            "per_tenant": scheduled_counts(schedule),
+        },
+        "wall_s": round(wall_s, 3),
+        "aggregate": _summarize_group(results, wall_s),
+        "tenants": tenants,
+        "server": (
+            metric_deltas(metrics_before, metrics_after)
+            if metrics_before is not None and metrics_after is not None
+            else None
+        ),
+        "checks": {"consistency": check_consistency(results)},
+    }
+    report["checks"]["fairness"] = check_fairness(report)
+    return report
+
+
+# ----------------------------------------------------------------------
+# Invariant checks
+# ----------------------------------------------------------------------
+
+
+def check_consistency(results: list[RequestResult]) -> dict:
+    """Greedy determinism across the run: every group of byte-identical
+    request bodies must have streamed byte-identical content. Only
+    completed requests participate — under chaos, quarantined victims are
+    EXPECTED casualties; a mismatch among the survivors is corruption."""
+    groups: dict[str, set[str]] = {}
+    sizes: dict[str, int] = {}
+    for r in results:
+        if r.outcome != "completed":
+            continue
+        groups.setdefault(r.body_key, set()).add(r.content)
+        sizes[r.body_key] = sizes.get(r.body_key, 0) + 1
+    violations = [
+        f"body {k}: {sizes[k]} completions streamed "
+        f"{len(variants)} distinct contents"
+        for k, variants in groups.items()
+        if len(variants) > 1
+    ]
+    return {
+        "ok": not violations,
+        "groups": len(groups),
+        "repeated_groups": sum(1 for k in groups if sizes[k] > 1),
+        "violations": violations,
+    }
+
+
+def check_fairness(report: dict) -> dict:
+    """Count-level fairness/accounting invariants over the finished run
+    (see module docstring)."""
+    violations: list[str] = []
+    tenants: dict[str, dict] = report.get("tenants", {})
+    completed_anywhere = any(
+        t["counts"]["completed"] > 0 for t in tenants.values()
+    )
+    for name, t in tenants.items():
+        accounted = sum(t["counts"].values())
+        if accounted != t["scheduled"]:
+            violations.append(
+                f"tenant {name!r}: {accounted} outcomes for "
+                f"{t['scheduled']} scheduled arrivals (requests lost)"
+            )
+        if (
+            completed_anywhere
+            and t["scheduled"] > 0
+            and t["counts"]["completed"] == 0
+        ):
+            violations.append(
+                f"tenant {name!r} starved: 0 of {t['scheduled']} arrivals "
+                "completed while other tenants were served"
+            )
+    return {"ok": not violations, "violations": violations}
+
+
+def check_isolation(
+    tenant: str,
+    uncontended: list[RequestResult],
+    contended: list[RequestResult],
+    bound: float = 10.0,
+    slack_ms: float = 1000.0,
+) -> dict:
+    """Two-phase tenant-isolation check: tenant ``tenant``'s p99 TTFT
+    under full contention must stay within ``bound × uncontended p99 +
+    slack_ms``. The slack term absorbs tiny-model CI noise where the
+    uncontended p99 is single-digit milliseconds and a multiplicative
+    bound alone would be a coin flip."""
+    solo = [
+        r.ttft_ms for r in uncontended
+        if r.tenant == tenant and r.outcome == "completed"
+        and r.ttft_ms is not None
+    ]
+    mixed = [
+        r.ttft_ms for r in contended
+        if r.tenant == tenant and r.outcome == "completed"
+        and r.ttft_ms is not None
+    ]
+    if not solo or not mixed:
+        return {
+            "ok": False,
+            "violations": [
+                f"tenant {tenant!r}: no completed samples in "
+                f"{'solo' if not solo else 'mixed'} phase"
+            ],
+        }
+    p99_solo = percentile(solo, 99)
+    p99_mixed = percentile(mixed, 99)
+    limit = bound * p99_solo + slack_ms
+    ok = p99_mixed <= limit
+    return {
+        "ok": ok,
+        "tenant": tenant,
+        "uncontended_p99_ttft_ms": round(p99_solo, 3),
+        "contended_p99_ttft_ms": round(p99_mixed, 3),
+        "bound": bound,
+        "slack_ms": slack_ms,
+        "limit_ms": round(limit, 3),
+        "violations": [] if ok else [
+            f"tenant {tenant!r}: contended p99 TTFT {p99_mixed:.1f} ms "
+            f"exceeds {limit:.1f} ms ({bound}x uncontended "
+            f"{p99_solo:.1f} ms + {slack_ms:.0f} ms slack)"
+        ],
+    }
+
+
+def failed_checks(report: dict) -> list[str]:
+    """Flatten every check's violations (the CLI's --assert exit path)."""
+    out: list[str] = []
+    for name, chk in (report.get("checks") or {}).items():
+        if chk and not chk.get("ok", True):
+            out.extend(f"[{name}] {v}" for v in chk.get("violations", []))
+    return out
+
+
+def dump_report(report: dict, path: str | None) -> str:
+    text = json.dumps(report, indent=2, sort_keys=False)
+    if path:
+        with open(path, "w") as f:
+            f.write(text + "\n")
+    return text

@@ -1,0 +1,109 @@
+"""In-process self-hosted target: the REAL serving stack (ApiState +
+ThreadingHTTPServer + BatchScheduler) on a tiny synthetic model.
+
+The CI-scale loadgen gate needs a server it can build in seconds on a CPU
+runner; this module stands one up from the same pieces ``serve()`` wires
+in production — telemetry enabled (the report scrapes ``/metrics``), an
+optional ``--faults`` chaos plan installed BEFORE construction (the
+bind-once contract, docs/ROBUSTNESS.md), batched decode with the paged
+prefix cache on — so a smoke run exercises admission, fairness,
+preemption, quarantine and the radix cache through real HTTP, not mocks.
+
+Zero production use: the point of `--self-host` is the zero-to-report
+path (`python -m distributed_llama_tpu.loadgen --self-host`) and the CI
+fairness/chaos gates in .github/workflows/main.yml.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import types
+from http.server import ThreadingHTTPServer
+
+
+@dataclasses.dataclass
+class SelfHost:
+    url: str
+    state: object  # ApiState
+    server: ThreadingHTTPServer
+    plan: object | None = None  # the installed FaultPlan, if any
+
+    def reset_faults(self) -> None:
+        """Rewind the chaos plan's hit/fired counters (same plan object the
+        scheduler bound). Called after warmup so ``after=``/``count=`` rule
+        gates count MEASURED-window hits — otherwise warmup's decode fetches
+        consume them and the chaos run silently injects nothing."""
+        if self.plan is not None:
+            self.plan.reset()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+
+
+def start_selfhost(
+    parallel: int = 4,
+    seq_len: int = 256,
+    tenants: str | None = None,
+    preempt: bool = True,
+    faults_spec: str | None = None,
+    faults_seed: int = 0,
+    decode_chunk: int = 4,
+    kv_page_size: int = 16,
+    admission_queue: int | None = None,
+    deadline_ms: float | None = None,
+    seed: int = 0,
+) -> SelfHost:
+    """Build the tiny synthetic model + tokenizer, construct the real
+    ApiState (batched decode, prefix cache, weighted-fair admission) and
+    serve it on an ephemeral port. Mirrors ``server.api.serve``'s
+    construction ORDER: telemetry before instruments bind, the fault plan
+    before the scheduler binds its hooks."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu import telemetry
+    from distributed_llama_tpu.engine import InferenceEngine, faults
+    from distributed_llama_tpu.formats.synthetic import (
+        synthetic_tokenizer_data,
+        tiny_spec,
+        write_synthetic_model,
+    )
+    from distributed_llama_tpu.server.api import ApiState, make_handler
+    from distributed_llama_tpu.tokenizer import Sampler, Tokenizer
+
+    telemetry.enable()
+    plan = None
+    if faults_spec:
+        plan = faults.parse(faults_spec, seed=faults_seed)
+        faults.install(plan)
+    tok = Tokenizer(synthetic_tokenizer_data())
+    spec = tiny_spec(seq_len=seq_len, vocab_size=tok.vocab_size)
+    path = write_synthetic_model(
+        os.path.join(tempfile.mkdtemp(prefix="dllama-loadgen-"), "m.m"),
+        spec, seed=seed,
+    )
+    engine = InferenceEngine(path, dtype=jnp.float32)
+    sampler = Sampler(
+        vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, seed=1
+    )
+    args = types.SimpleNamespace(
+        temperature=0.0, topp=0.9, seed=1, chat_template=None,
+        parallel=parallel, batch_decode=True, decode="device",
+        decode_chunk=decode_chunk, prefill_chunk=64,
+        prefix_cache=True, kv_pages=None, kv_page_size=kv_page_size,
+        tenants=tenants, preempt=preempt,
+        admission_queue=admission_queue, deadline_ms=deadline_ms,
+        stall_timeout_s=60.0,
+    )
+    state = ApiState(engine, tok, sampler, args)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
+    server.daemon_threads = True
+    threading.Thread(
+        target=server.serve_forever, name="dllama-selfhost", daemon=True
+    ).start()
+    return SelfHost(
+        url=f"http://127.0.0.1:{server.server_address[1]}",
+        state=state, server=server, plan=plan,
+    )

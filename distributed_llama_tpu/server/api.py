@@ -48,6 +48,7 @@ import argparse
 import dataclasses
 import json
 import math
+import random
 import signal
 import threading
 import time
@@ -57,6 +58,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from distributed_llama_tpu import telemetry
 from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine.faults import DeadlineExceeded
+from distributed_llama_tpu.server.admission import (
+    DEFAULT_TENANT,
+    AdmissionRejected,
+    FairAdmission,
+    ServerDraining,
+    parse_tenants,
+)
 from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.tokenizer import (
     ChatItem,
@@ -84,17 +92,15 @@ class BadRequest(ValueError):
     """Client error in a request body — mapped to HTTP 400 by the handler."""
 
 
-class AdmissionRejected(RuntimeError):
-    """The bounded admission queue is full — mapped to HTTP 429 with a
-    ``Retry-After`` header (the alternative is the seed's unbounded queue:
-    every queued client holds a socket + handler thread while its own
-    timeout burns, then retries into an even deeper queue)."""
+# AdmissionRejected (→429) and ServerDraining (→503) live with the
+# weighted-fair admission machinery in server/admission.py (ISSUE 8) and
+# are re-exported above for compatibility with existing imports.
 
-
-class ServerDraining(RuntimeError):
-    """The server received SIGTERM and stopped admitting — mapped to HTTP
-    503 with ``Retry-After`` so load balancers move on while in-flight
-    completions finish."""
+# a preempted request requeues through fair admission at most this many
+# times before the server answers 503 + Retry-After: the deadline is the
+# real bound, but a deadline-less victim under sustained higher-priority
+# pressure must not requeue forever on one handler thread
+MAX_PREEMPT_REQUEUES = 3
 
 
 @dataclasses.dataclass
@@ -148,6 +154,7 @@ class StreamSlot:
     cache: NaiveCache
     sampler: Sampler
     busy: bool = False
+    tenant: str | None = None  # the occupying request's tenant (metrics)
 
 
 class ApiState:
@@ -218,7 +225,6 @@ class ApiState:
         ]
         self.cache = self.slots[0].cache  # single-stream tests poke this
         self._mutex = threading.Lock()
-        self._free = threading.Semaphore(n)
         # fault tolerance (ISSUE 3): bounded admission queue, per-request
         # deadlines, request-body cap, and the SIGTERM drain flag
         aq = getattr(args, "admission_queue", None)
@@ -226,10 +232,32 @@ class ApiState:
         mb = getattr(args, "max_body_bytes", None)  # 0 is a valid cap — no falsy-or
         self.max_body_bytes = int(mb) if mb is not None else (1 << 20)
         self.default_deadline_ms = getattr(args, "deadline_ms", None)
-        self.retry_after_s = 1
+        # multi-tenant weighted-fair admission (ISSUE 8): per-tenant
+        # bounded queues with deficit-weighted dequeue into the serving
+        # slots, priority classes first (server/admission.py). --tenants
+        # declares weights/priorities; unknown tenants auto-register at
+        # weight 1 / priority 0
+        self.tenants = parse_tenants(getattr(args, "tenants", None))
+        self.admission = FairAdmission(
+            n, tenants=self.tenants, queue_limit=self.queue_limit
+        )
+        if self.batch is not None and getattr(args, "preempt", True):
+            # priority preemption: a queued high-priority arrival may evict
+            # the lowest-priority decode row to a clean requeue (the hook
+            # runs OUTSIDE the admission lock — see admission.acquire)
+            self.admission.preempt_hook = self.batch.preempt_below
+        # jittered Retry-After (ISSUE 8 satellite): a fixed value tells
+        # every rejected client to come back on the same tick, and the
+        # synchronized retry storm re-spikes the admission queue (loadgen's
+        # bursty mode demonstrates it). Entropy-seeded ON PURPOSE — seeding
+        # deterministically would re-synchronize replicas restored from the
+        # same image, recreating the herd this exists to break up.
+        self.retry_after_base_s = 1
+        self.retry_after_jitter_s = max(
+            0, int(getattr(args, "retry_after_jitter_s", 2) or 0)
+        )
+        self._retry_rng = random.Random()
         self.draining = False
-        self._admission_lock = threading.Lock()
-        self._waiting = 0
         # server instrument bundle (requests / duration / in-flight / queue
         # wait): real registry metrics when telemetry is enabled at startup,
         # shared no-op singletons otherwise
@@ -244,49 +272,51 @@ class ApiState:
         503 + Retry-After, ``/readyz`` flips 503, in-flight requests finish.
         Idempotent."""
         self.draining = True
+        self.admission.begin_drain()
         self.tel.draining.set(1)
 
+    def retry_after(self) -> int:
+        """Seconds for a 429/503 ``Retry-After`` header: base + uniform
+        jitter, drawn PER RESPONSE, so a burst of rejected clients retries
+        spread over the window instead of re-spiking the queue in sync."""
+        return self.retry_after_base_s + self._retry_rng.randint(
+            0, self.retry_after_jitter_s
+        )
+
     def _acquire_slot(
-        self, messages: list[dict], deadline: float | None = None
+        self, messages: list[dict], deadline: float | None = None,
+        tenant: str = DEFAULT_TENANT, priority: int = 0,
     ) -> StreamSlot:
-        """Take a free lane, queueing BOUNDEDLY when all are busy: at most
-        ``queue_limit`` requests wait (excess get AdmissionRejected → 429),
-        and a queued request whose deadline expires leaves with
+        """Take a free lane through weighted-fair admission: when all are
+        busy the request queues BOUNDEDLY under its own tenant (excess get
+        AdmissionRejected → 429), slots are granted priority-class-first
+        then deficit-weighted round-robin across tenants, a high-priority
+        arrival may preempt a lower-priority decode row (the admission
+        hook), and a queued request whose deadline expires leaves with
         DeadlineExceeded → 504 instead of burning its remaining budget in
         line. The chosen lane is the free one whose chat prefix cache
         reuses the most of this request (prefix affinity keeps multi-turn
         KV reuse working under concurrency)."""
         sw = Stopwatch()
-        if not self._free.acquire(blocking=False):
-            with self._admission_lock:
-                if self.draining:
-                    raise ServerDraining("server is draining; not admitting")
-                if self._waiting >= self.queue_limit:
-                    self.tel.admission_rejected.inc()
-                    raise AdmissionRejected(
-                        f"admission queue full ({self._waiting} waiting, "
-                        f"limit {self.queue_limit}); retry after "
-                        f"{self.retry_after_s}s"
-                    )
-                self._waiting += 1
-            try:
-                timeout = (
-                    None if deadline is None
-                    else max(deadline - time.monotonic(), 0.0)
-                )
-                if not self._free.acquire(timeout=timeout):
-                    raise DeadlineExceeded(
-                        "deadline expired while queued for a free slot"
-                    )
-            finally:
-                with self._admission_lock:
-                    self._waiting -= 1
+        tel = self.tel
+        try:
+            self.admission.acquire(tenant, priority, deadline)
+        except AdmissionRejected:
+            tel.admission_rejected.inc()
+            tel.tenant_rejected.labels(tenant=tenant).inc()
+            raise
+        finally:
+            tel.tenant_queue_depth.labels(tenant=tenant).set(
+                self.admission.queue_depth(tenant)
+            )
         if self.draining:
             # a SIGTERM that landed while this request queued: give the slot
             # back and bounce — the drain waiter counts acquirable slots
-            self._free.release()
+            self.admission.release()
             raise ServerDraining("server is draining; not admitting")
-        self.tel.queue_wait.observe(sw.elapsed_s())
+        tel.queue_wait.observe(sw.elapsed_s())
+        tel.tenant_admitted.labels(tenant=tenant).inc()
+        tel.tenant_active.labels(tenant=tenant).inc()
         with self._mutex:
             free = [s for s in self.slots if not s.busy]
             # primary: longest prefix reuse; tie-break: prefer an EMPTY
@@ -297,12 +327,16 @@ class ApiState:
                 key=lambda s: (s.cache.match_len(messages), 0 if s.cache.items else 1),
             )
             slot.busy = True
+            slot.tenant = tenant
             return slot
 
     def _release_slot(self, slot: StreamSlot) -> None:
+        tenant = slot.tenant or DEFAULT_TENANT
         with self._mutex:
             slot.busy = False
-        self._free.release()
+            slot.tenant = None
+        self.admission.release()
+        self.tel.tenant_active.labels(tenant=tenant).dec()
 
     def complete(
         self, body: dict, send_chunk, params: dict | None = None,
@@ -322,26 +356,79 @@ class ApiState:
             request_id = new_request_id()
         # deadline: request deadline_ms, else the server default; converted
         # to a monotonic instant ONCE so queue wait, prefill and decode all
-        # burn the same budget. Enforced here per token (feed), by the batch
-        # scheduler between chunks, and by the bounded admission queue.
+        # burn the same budget — ACROSS preemption requeues too. Enforced
+        # here per token (feed), by the batch scheduler between chunks, and
+        # by the bounded admission queue.
         deadline_ms = params.get("deadline_ms") or self.default_deadline_ms
         deadline = (
             time.monotonic() + float(deadline_ms) / 1000.0
             if deadline_ms else None
         )
+        # canonicalize ONCE: past the admission registry's auto-register
+        # cap, unknown names fold into the default bucket here — before
+        # any per-tenant metric label is minted from the raw client string
+        tenant = self.admission.resolve(params.get("tenant") or DEFAULT_TENANT)
+        priority = params.get("priority")
+        if priority is None:
+            priority = self.admission.config(tenant).priority
         if self.draining:
             raise ServerDraining("server is draining; not admitting")
-        slot = self._acquire_slot(params["messages"], deadline)
-        try:
-            slot.stream.deadline = deadline
-            # per-request prefix-cache opt-out (`cache: off` in the body):
-            # the row neither matches nor publishes shared KV pages
-            slot.stream.prefix_cache_enabled = params.get("cache", "on") != "off"
-            return self._complete_on(slot, params, send_chunk, request_id, deadline)
-        finally:
-            slot.stream.deadline = None
-            slot.stream.prefix_cache_enabled = True
-            self._release_slot(slot)
+        # preemption requeue (ISSUE 8): an evicted request re-enters fair
+        # admission and RE-RUNS from its prompt — the re-run prefills
+        # through the prefix cache's published pages and (same seed)
+        # decodes bit-identically, so suppressing the first `sent` SSE
+        # deltas replays exactly the continuation the client is owed
+        # pin the sampling seed ONCE per request, not per attempt: seedless
+        # sampled requests otherwise re-derive a fresh wall-clock seed in
+        # _complete_on on every preemption requeue, and the re-run samples
+        # a DIFFERENT completion whose replayed prefix guarded_send would
+        # silently splice onto the first run's already-sent deltas
+        if params.get("seed") is None:
+            params["seed"] = int(time.time_ns() % (1 << 31))
+        sent = 0
+        skip = 0
+
+        def guarded_send(data: str):
+            nonlocal sent, skip
+            if skip > 0:
+                skip -= 1  # an already-delivered delta, identical by the
+                return     # bit-parity contract — swallow the replay
+            send_chunk(data)
+            sent += 1
+
+        for attempt in range(MAX_PREEMPT_REQUEUES + 1):
+            skip = sent  # re-runs replay (and suppress) what was delivered
+            slot = self._acquire_slot(
+                params["messages"], deadline, tenant, priority
+            )
+            try:
+                slot.stream.deadline = deadline
+                # per-request prefix-cache opt-out (`cache: off` in the
+                # body): the row neither matches nor publishes shared pages
+                slot.stream.prefix_cache_enabled = (
+                    params.get("cache", "on") != "off"
+                )
+                # label the row for preempt_below's victim selection
+                slot.stream.tenant = tenant
+                slot.stream.priority = priority
+                return self._complete_on(
+                    slot, params, guarded_send, request_id, deadline
+                )
+            except faults.RowPreempted:
+                if attempt >= MAX_PREEMPT_REQUEUES:
+                    raise
+                self.tel.preempt_requeues.inc()
+            finally:
+                slot.stream.deadline = None
+                slot.stream.prefix_cache_enabled = True
+                slot.stream.tenant = None
+                slot.stream.priority = None
+                if self.batch is not None:
+                    # drop an unconsumed eviction marker (the request beat
+                    # its preemption to the finish line) so it cannot leak
+                    # into the row's next request
+                    self.batch.retract_preemption(slot.stream)
+                self._release_slot(slot)
 
     def _complete_on(
         self, slot: StreamSlot, params: dict, send_chunk, request_id: str,
@@ -587,8 +674,19 @@ class ApiState:
             deadline_ms = body.get("deadline_ms")
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)
+            priority = body.get("priority")
+            if priority is not None:
+                priority = int(priority)
         except (TypeError, ValueError) as e:
             raise BadRequest(f"invalid numeric field: {e}") from None
+        # multi-tenant routing metadata (ISSUE 8, docs/SERVING.md): tenant
+        # names feed the weighted-fair admission queues; priority defaults
+        # to the tenant's configured class when the body omits it
+        tenant = body.get("tenant", DEFAULT_TENANT)
+        if not isinstance(tenant, str) or not tenant or len(tenant) > 64:
+            raise BadRequest(
+                "'tenant' must be a non-empty string of at most 64 chars"
+            )
         if deadline_ms is not None and not (
             math.isfinite(deadline_ms) and deadline_ms > 0
         ):
@@ -609,6 +707,8 @@ class ApiState:
             "max_tokens": max_tokens,
             "stop": [s for s in stop if s],
             "deadline_ms": deadline_ms,
+            "tenant": tenant,
+            "priority": priority,
         }
 
 
@@ -818,19 +918,48 @@ def make_handler(state: ApiState):
                 self.close_connection = True
                 return "499"
             except AdmissionRejected as e:
-                # raised before any SSE byte (admission precedes decoding)
-                self._send_json(
-                    429, self._error_body(str(e), "overloaded", rid),
-                    request_id=rid,
-                    extra_headers={"Retry-After": str(state.retry_after_s)},
-                )
+                # usually raised before any SSE byte (admission precedes
+                # decoding) — but a preemption REQUEUE re-enters admission
+                # mid-stream, so a full queue can also surface here after
+                # deltas went out; then it must end the event stream, not
+                # write a second status line into it. Retry-After is
+                # JITTERED per response: a burst of 429s with one fixed
+                # value retries back in lockstep and re-spikes the queue
+                # (ISSUE 8 satellite)
+                if sse_started:
+                    _sse_terminal_error(str(e), "overloaded")
+                else:
+                    self._send_json(
+                        429, self._error_body(str(e), "overloaded", rid),
+                        request_id=rid,
+                        extra_headers={"Retry-After": str(state.retry_after())},
+                    )
                 return "429"
             except ServerDraining as e:
-                self._send_json(
-                    503, self._error_body(str(e), "draining", rid),
-                    request_id=rid,
-                    extra_headers={"Retry-After": str(state.retry_after_s)},
-                )
+                # same mid-stream possibility as AdmissionRejected: a
+                # requeue can meet a drain that began after the SSE headers
+                if sse_started:
+                    _sse_terminal_error(str(e), "draining")
+                else:
+                    self._send_json(
+                        503, self._error_body(str(e), "draining", rid),
+                        request_id=rid,
+                        extra_headers={"Retry-After": str(state.retry_after())},
+                    )
+                return "503"
+            except faults.RowPreempted as e:
+                # a preempted request re-runs transparently inside
+                # state.complete(); reaching here means it was evicted
+                # MAX_PREEMPT_REQUEUES times in a row — shed it like
+                # overload rather than spinning a handler thread forever
+                if sse_started:
+                    _sse_terminal_error(str(e), "preempted")
+                else:
+                    self._send_json(
+                        503, self._error_body(str(e), "preempted", rid),
+                        request_id=rid,
+                        extra_headers={"Retry-After": str(state.retry_after())},
+                    )
                 return "503"
             except DeadlineExceeded as e:
                 state.tel.deadline_exceeded.inc()
@@ -858,13 +987,10 @@ def make_handler(state: ApiState):
 
 
 def drain_then_shutdown(state: ApiState, server, timeout_s: float) -> None:
-    """Wait for every in-flight completion to finish (all slot semaphore
-    permits reacquirable), capped at ``timeout_s``, then stop the HTTP
-    server. Runs on its own thread so the SIGTERM handler returns
-    immediately."""
-    deadline = time.monotonic() + max(timeout_s, 0.0)
-    for _ in range(len(state.slots)):
-        state._free.acquire(timeout=max(deadline - time.monotonic(), 0.001))
+    """Wait for every in-flight completion to finish (all admission
+    permits back), capped at ``timeout_s``, then stop the HTTP server.
+    Runs on its own thread so the SIGTERM handler returns immediately."""
+    state.admission.drain_wait(timeout_s)
     server.shutdown()
 
 
@@ -990,6 +1116,30 @@ def main(argv=None) -> None:
         "--max-body-bytes", type=int, default=1 << 20,
         help="request-body size cap; larger Content-Length gets 413 "
         "without reading the body (default 1 MiB)",
+    )
+    # multi-tenant fairness + priority preemption (ISSUE 8, docs/SERVING.md)
+    parser.add_argument(
+        "--tenants", type=str, default=None,
+        help="tenant admission contracts: ';'-separated "
+        "'name:weight=W,priority=P,queue=Q' entries, e.g. "
+        "'gold:weight=4,priority=10;free:weight=1'. Weights set DRR "
+        "admission shares under saturation; priority sets the default "
+        "class for the tenant's requests (bodies may override with a "
+        "'priority' field). Unknown tenants auto-register at weight 1, "
+        "priority 0",
+    )
+    parser.add_argument(
+        "--preempt", action=argparse.BooleanOptionalAction, default=True,
+        help="allow a queued higher-priority request to evict the "
+        "lowest-priority batched decode row to a clean requeue (the "
+        "victim resumes through the prefix cache, bit-identically; "
+        "batched serving only). --no-preempt queues strictly",
+    )
+    parser.add_argument(
+        "--retry-after-jitter-s", type=int, default=2,
+        help="max uniform jitter ADDED to the 1s Retry-After base on "
+        "429/503 responses, drawn per response (desynchronizes client "
+        "retry storms; 0 restores the fixed value)",
     )
     parser.add_argument(
         "--deadline-ms", type=float, default=None,

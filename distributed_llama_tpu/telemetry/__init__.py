@@ -266,6 +266,15 @@ class EngineInstruments:
             "dllama_watchdog_stalls_total",
             "Hung batched chunks the stall watchdog failed cleanly",
         )
+        # multi-tenant serving (ISSUE 8): priority preemption evicts the
+        # lowest-priority decode row to a clean requeue — count evictions
+        # here (the serving layer counts the successful requeues)
+        self.preemptions = counter(
+            "dllama_preemptions_total",
+            "Decode rows evicted by a higher-priority arrival and requeued "
+            "(clean RowPreempted evictions; a chaos-failed eviction counts "
+            "as a quarantine instead)",
+        )
         # speculative decoding (--spec-draft): draft volume, acceptance and
         # per-step advance — the health read is accepted/draft (the
         # prompt-lookup hit rate) and the advance histogram's mass above 1
@@ -424,6 +433,36 @@ class ServerInstruments:
             "dllama_server_draining",
             "1 while the server is draining (SIGTERM received: no new "
             "admissions, in-flight completions finishing)",
+        )
+        # multi-tenant fairness surface (ISSUE 8): per-tenant admission
+        # accounting behind the weighted-fair queues (server/admission.py)
+        self.tenant_admitted = counter(
+            "dllama_tenant_admitted_total",
+            "Completion requests admitted to a serving slot, by tenant "
+            "(weighted-fair DRR dequeue; docs/SERVING.md)",
+            labelnames=("tenant",),
+        )
+        self.tenant_rejected = counter(
+            "dllama_tenant_rejected_total",
+            "Completion requests rejected 429 at a full tenant (or global) "
+            "admission queue, by tenant",
+            labelnames=("tenant",),
+        )
+        self.tenant_queue_depth = gauge(
+            "dllama_tenant_queue_depth",
+            "Requests currently queued for admission, by tenant",
+            labelnames=("tenant",),
+        )
+        self.tenant_active = gauge(
+            "dllama_tenant_active",
+            "Completion requests currently holding a serving slot, by tenant",
+            labelnames=("tenant",),
+        )
+        self.preempt_requeues = counter(
+            "dllama_preempted_requeued_total",
+            "Preempted requests requeued through weighted-fair admission "
+            "(each resumes from the prefix cache's published pages; pairs "
+            "with dllama_preemptions_total on the eviction side)",
         )
 
 

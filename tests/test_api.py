@@ -436,13 +436,14 @@ class TestApiHardening:
                 send_then_die,
             )
         assert sent  # it was genuinely mid-stream
-        # the slot is free again: busy flags cleared and the semaphore
+        # the slot is free again: busy flags cleared and the admission
         # permits restored (all lanes acquirable)
         assert all(not s.busy for s in state.slots)
+        assert state.admission.free_slots() == len(state.slots)
         for _ in range(len(state.slots)):
-            assert state._free.acquire(blocking=False)
+            state.admission.acquire("test")
         for _ in range(len(state.slots)):
-            state._free.release()
+            state.admission.release()
         # stream position rewound to tokens actually consumed (no
         # speculative-chunk overshoot pinned on the lane)
         used = [s for s in state.slots if s.stream.total_tokens() > 0]

@@ -446,7 +446,7 @@ class TestServingUnderFaults:
                            admission_queue=4)
         url, server = serve_state(state)
         try:
-            assert state._free.acquire(blocking=False)  # hold the only slot
+            state.admission.acquire("test")  # hold the only slot
             t0 = time.monotonic()
             status, headers, body = post_raw(
                 url, {"messages": [{"role": "user", "content": "hi"}],
@@ -456,7 +456,7 @@ class TestServingUnderFaults:
             assert body["error"]["type"] == "deadline_exceeded"
             assert time.monotonic() - t0 < 30  # did not queue unboundedly
         finally:
-            state._free.release()
+            state.admission.release()
             server.shutdown()
 
     def test_deadline_mid_stream_sends_sse_error_event(self, tmp_path):
@@ -513,15 +513,18 @@ class TestServingUnderFaults:
                            admission_queue=0)
         url, server = serve_state(state)
         try:
-            assert state._free.acquire(blocking=False)
+            state.admission.acquire("test")
             status, headers, body = post_raw(
                 url, {"messages": [{"role": "user", "content": "hi"}]},
             )
             assert status == 429
-            assert headers.get("Retry-After") == "1"
+            # jittered per response (ISSUE 8 satellite): base 1s + up to
+            # --retry-after-jitter-s of spread, never the old fixed "1"
+            ra = int(headers.get("Retry-After"))
+            assert 1 <= ra <= 1 + state.retry_after_jitter_s
             assert body["error"]["type"] == "overloaded"
         finally:
-            state._free.release()
+            state.admission.release()
             server.shutdown()
 
     def test_oversized_body_is_413(self, tmp_path):
@@ -609,7 +612,8 @@ class TestLifecycle:
                 url, {"messages": [{"role": "user", "content": "hi"}]},
             )
             assert status == 503
-            assert headers.get("Retry-After") == "1"
+            ra = int(headers.get("Retry-After"))
+            assert 1 <= ra <= 1 + state.retry_after_jitter_s
             assert body["error"]["type"] == "draining"
         finally:
             server.shutdown()
@@ -628,7 +632,7 @@ class TestLifecycle:
         old = signal.getsignal(signal.SIGTERM)
         try:
             install_sigterm_drain(state, stub, timeout_s=20.0)
-            assert state._free.acquire(blocking=False)  # one request in flight
+            state.admission.acquire("test")  # one request in flight
             signal.raise_signal(signal.SIGTERM)
             deadline = time.monotonic() + 5
             while not state.draining and time.monotonic() < deadline:
@@ -636,7 +640,7 @@ class TestLifecycle:
             assert state.draining
             # the listener must NOT stop while the request is in flight
             assert not stub.down.wait(timeout=0.3)
-            state._free.release()  # in-flight completion finishes
+            state.admission.release()  # in-flight completion finishes
             assert stub.down.wait(timeout=10)
         finally:
             signal.signal(signal.SIGTERM, old)
@@ -650,11 +654,11 @@ class TestLifecycle:
             def shutdown(self):
                 done.set()
 
-        assert state._free.acquire(blocking=False)  # a request that never ends
+        state.admission.acquire("test")  # a request that never ends
         try:
             t0 = time.monotonic()
             drain_then_shutdown(state, StubServer(), timeout_s=0.3)
             assert done.is_set()
             assert time.monotonic() - t0 < 5  # the cap held
         finally:
-            state._free.release()
+            state.admission.release()
