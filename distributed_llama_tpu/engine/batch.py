@@ -77,6 +77,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_llama_tpu import retry
 from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket, next_pow2
 from distributed_llama_tpu.engine.speculative import PromptLookupDrafter
@@ -491,6 +492,7 @@ class BatchScheduler:
         prefill_chunk: int = 0,
         spec_draft: int = 0,
         spec_ngram: int = 3,
+        replica_id: int = 0,
     ):
         tp_engine = engine._tp_engine
         if tp_engine is not None and not hasattr(tp_engine, "batched_decode_chunk"):
@@ -600,8 +602,28 @@ class BatchScheduler:
         # plan is installed — one no-op attribute call per dispatch)
         self.retries = max(0, int(retries))
         self.retry_backoff_s = float(retry_backoff_s)
+        # the shared backoff vocabulary (distributed_llama_tpu/retry.py):
+        # same schedule the old inline loops slept — base * 2**attempt
+        self._retry_policy = retry.BackoffPolicy(
+            attempts=self.retries + 1, base_s=self.retry_backoff_s
+        )
         self.stall_timeout_s = stall_timeout_s
         self._faults = faults.active_plan()
+        # replica-loss fault domain (ISSUE 9): this scheduler IS one
+        # data-parallel replica when a server/replicas.py pool owns it.
+        # ``replica_id`` scopes the replica.* chaos sites (a rule's row=
+        # field selects the replica), ``health_hook(event, value)`` feeds
+        # the pool's health state machine ("roundtrip" per chunk fetch,
+        # "stall"/"lost" on death) and must only take LEAF locks — never
+        # this cond — and ``lost_on_stall`` escalates a watchdog stall
+        # from per-row StallTimeout to a whole-replica loss (the victims
+        # then REQUEUE onto surviving replicas instead of failing 500)
+        self.replica_id = int(replica_id)
+        self.health_hook = None
+        self.lost_on_stall = False
+        self._lost = False
+        self.lost_cause: str | None = None
+        self.lost_victims = 0
         # priority preemption (ISSUE 8): clean evictions performed by
         # preempt_below — a plain counter so tests/loadgen read it with
         # telemetry off (the registry's dllama_preemptions_total mirrors it)
@@ -637,6 +659,54 @@ class BatchScheduler:
         """Stop the watchdog thread (tests; a serving scheduler lives for
         the process)."""
         self._shutdown = True
+
+    # ------------------------------------------------------------------
+    # Replica loss (ISSUE 9): the whole-scheduler failure domain. A crash
+    # (injected or real) at a dispatch, or a stall the watchdog escalates,
+    # retires EVERY in-flight request with a typed ReplicaLost — the
+    # serving layer requeues them through fair admission onto surviving
+    # replicas and replays them bit-identically; the pool supervisor
+    # restarts this replica with jittered backoff (server/replicas.py).
+    # ------------------------------------------------------------------
+
+    def mark_lost(self, cause: str) -> None:
+        """Declare this replica dead (pool/tests entry point). Idempotent."""
+        with self._cond:
+            self._mark_lost_locked(cause)
+
+    def _mark_lost_locked(self, cause: str) -> None:
+        """The one death path (cond held): every stream gets ReplicaLost
+        (a mid-prefill request raises it at its next chunk boundary, a
+        decoding one at its next ``next_token``), page pins release, the
+        dispatched-but-unfetched chunk is dropped with its depth hold, the
+        watchdog stands down, and the health hook reports the loss. The
+        hook only takes LEAF locks (pool/admission/registry), so calling
+        it under this cond cannot deadlock."""
+        if self._lost:
+            return
+        self._lost = True
+        self.lost_cause = cause
+        self.lost_victims = sum(1 for s in self._streams if s._joined)
+        for s in self._streams:
+            s._fetch_error = faults.ReplicaLost(
+                f"replica {self.replica_id} lost: {cause}"
+            )
+            self._release_pins_locked(s)
+        if self._pending is not None:
+            # the speculative chunk dies with the replica: nobody will
+            # fetch it, so its depth hold releases here
+            self._pending = None
+            with self.engine._depth_lock:
+                self.engine._pipeline_depth -= 1
+        self._shutdown = True  # a dead replica's watchdog has no duties
+        self._cond.notify_all()
+        hook = self.health_hook
+        if hook is not None:
+            hook("lost", float(self.lost_victims))
+
+    @property
+    def lost(self) -> bool:
+        return self._lost
 
     def _watchdog_loop(self) -> None:
         """Detect a hung chunk fetch and fail the batch CLEANLY: joined rows
@@ -674,6 +744,22 @@ class BatchScheduler:
                     released += 1
                 with self.engine._depth_lock:
                     self.engine._pipeline_depth -= released
+                tel.watchdog_stalls.inc()
+                if self.lost_on_stall:
+                    # supervised replica (ISSUE 9): a stalled chunk is a
+                    # replica-level loss — victims requeue onto surviving
+                    # replicas instead of dying with StallTimeout, and
+                    # the supervisor restarts this replica. The hook's
+                    # "stall" event walks the pool's health machine
+                    # through suspect before "lost" declares death.
+                    hook = self.health_hook
+                    if hook is not None:
+                        hook("stall", self.stall_timeout_s)
+                    self._mark_lost_locked(
+                        "chunk fetch exceeded the "
+                        f"{self.stall_timeout_s:.1f}s stall timeout"
+                    )
+                    continue
                 for s in self._streams:
                     if s._joined and s._fetch_error is None:
                         s._fetch_error = faults.StallTimeout(
@@ -681,7 +767,6 @@ class BatchScheduler:
                             f"{self.stall_timeout_s:.1f}s stall timeout"
                         )
                         self._release_pins_locked(s)
-                tel.watchdog_stalls.inc()
                 self._cond.notify_all()
 
     def new_stream(self) -> BatchStream:
@@ -710,6 +795,13 @@ class BatchScheduler:
         index of the last REAL token's row within them."""
         engine = self.engine
         n = tokens.shape[0]
+        if self._lost:
+            # a request placed on this replica just before it died: fail
+            # typed BEFORE touching the slab — the serving layer requeues
+            # it onto a surviving replica (no bytes were dispatched)
+            raise faults.ReplicaLost(
+                f"replica {self.replica_id} lost: {self.lost_cause}"
+            )
         if n == 0:
             raise ValueError("empty token batch: at least one token required")
         if stream.pos + n > engine.cfg.seq_len:
@@ -782,6 +874,19 @@ class BatchScheduler:
             padded = np.zeros(bucket, dtype=np.int32)
             padded[:c] = tokens[off : off + c]
             with self._cond:
+                try:
+                    # whole-replica crash site (ISSUE 9): prefill chunk
+                    # dispatches are round-trips too — a crash mid-prompt
+                    # must fail over exactly like one mid-decode
+                    self._faults.fire("replica.crash", row=self.replica_id)
+                except Exception as e:
+                    self._mark_lost_locked(f"injected crash at prefill: {e}")
+                if self._lost:
+                    err = stream._fetch_error or faults.ReplicaLost(
+                        f"replica {self.replica_id} lost: {self.lost_cause}"
+                    )
+                    stream._fetch_error = None
+                    raise err
                 if self._pool is not None:
                     # pool-enabled scheduler: every prefill runs the paged
                     # program — an unaliased row dispatches with matched 0
@@ -1039,13 +1144,17 @@ class BatchScheduler:
             stream._queue.clear()
             stream._epoch += 1
             stream._joined = True
-            if not isinstance(stream._fetch_error, faults.RowPreempted):
+            if not isinstance(
+                stream._fetch_error, (faults.RowPreempted, faults.ReplicaLost)
+            ):
                 # stale errors from a previous occupancy clear; a PREEMPTION
-                # that landed between this request's prefill and its decode
-                # join must survive the join (the first next_token raises it
-                # and the request requeues). Cross-request staleness is
-                # impossible: the serving layer retracts an unconsumed
-                # preemption when each request ends (retract_preemption)
+                # or REPLICA LOSS that landed between this request's prefill
+                # and its decode join must survive the join (the first
+                # next_token raises it and the request requeues). Cross-
+                # request staleness is impossible: the serving layer
+                # retracts an unconsumed preemption when each request ends
+                # (retract_preemption), and a lost replica never seats a
+                # new request (placement skips dead replicas)
                 stream._fetch_error = None
             self._cond.notify_all()
 
@@ -1058,6 +1167,19 @@ class BatchScheduler:
     # through the prefix cache and (same seed) streams bit-identically to
     # an uncontended run.
     # ------------------------------------------------------------------
+
+    def min_preemptible_priority(self) -> int | None:
+        """Lowest priority among this scheduler's currently evictable rows
+        (None when nothing is evictable): the replica pool ranks replicas
+        by this so a multi-replica preemption evicts the GLOBALLY
+        lowest-priority victim, not the first replica's local minimum
+        (server/replicas.py ``preempt_below``)."""
+        with self._cond:
+            prios = [
+                s.priority for s in self._streams
+                if s.priority is not None and s._fetch_error is None
+            ]
+            return min(prios) if prios else None
 
     def preempt_below(self, priority: int) -> bool:
         """Evict the lowest-priority active row whose priority is strictly
@@ -1228,28 +1350,37 @@ class BatchScheduler:
         the rows. KeyboardInterrupt/SystemExit release the depth and
         propagate (they must abort, not retry into quarantines)."""
         engine = self.engine
+        try:
+            # whole-replica crash site (ISSUE 9): NOT transient — no retry,
+            # no per-row quarantine. The scheduler is lost wholesale and
+            # every in-flight request requeues onto a surviving replica.
+            self._faults.fire("replica.crash", row=self.replica_id)
+        except Exception as e:
+            self._mark_lost_locked(f"injected crash at dispatch: {e}")
+            return None
         with engine._depth_lock:
             engine._pipeline_depth += 1  # released when the fetch drains
         result = None
         error: Exception | None = None
+
+        def attempt_once():
+            self._faults.fire("batch.dispatch")
+            return dispatch_fn()
+
         try:
-            for attempt in range(self.retries + 1):
-                try:
-                    self._faults.fire("batch.dispatch")
-                    result = dispatch_fn()
-                    error = None
-                    break
-                except Exception as e:
-                    # transient failures (an injected dispatch raise, a flaky
-                    # runtime) retry with backoff — briefly blocking joins
-                    # (the cond lock is held) is the cost of a coherent
-                    # active set
-                    error = e
-                    if attempt < self.retries:
-                        engine._tel.dispatch_retries.inc()
-                        # bounded backoff (retries * backoff_s) with the cond
-                        # held — the one sanctioned block under this lock
-                        time.sleep(self.retry_backoff_s * (2 ** attempt))  # dllama: noqa[LCK-002]
+            # transient failures (an injected dispatch raise, a flaky
+            # runtime) retry on the shared backoff policy
+            # (distributed_llama_tpu/retry.py — same base*2**attempt
+            # schedule the old inline loop slept). Briefly blocking joins
+            # is the cost of a coherent active set: the bounded
+            # retries*backoff sleep inside retry_call is the one
+            # sanctioned block under this lock.
+            result = retry.retry_call(  # dllama: noqa[LCK-002]
+                attempt_once, self._retry_policy,
+                on_retry=lambda a, e: engine._tel.dispatch_retries.inc(),
+            )
+        except Exception as e:
+            error = e
         except BaseException:
             with engine._depth_lock:
                 engine._pipeline_depth -= 1
@@ -1275,6 +1406,8 @@ class BatchScheduler:
         cache writes DROP and their outputs are discarded. In spec mode the
         chunk is a batched VERIFY step instead (``_dispatch_spec_locked``)."""
         engine = self.engine
+        if self._lost:
+            return  # every stream already carries its ReplicaLost
         if self.spec_draft > 0:
             self._dispatch_spec_locked()
             return
@@ -1366,6 +1499,8 @@ class BatchScheduler:
         fetched results; spec steps therefore never pipeline a second
         dispatch behind an in-flight fetch."""
         engine = self.engine
+        if self._lost:
+            return  # every stream already carries its ReplicaLost
         if self._fetching:
             # the next window's drafts depend on THIS step's emitted
             # tokens: wait for the fetch instead of dispatching blind
@@ -1463,25 +1598,32 @@ class BatchScheduler:
         mode, tokens_dev, snapshot, bucket, n_active, sw, spec_lens = pend
         toks = None
         error: Exception | None = None
+
+        def attempt_once():
+            self._faults.fire("batch.fetch")
+            # replica chaos (ISSUE 9): `slow` (kind=delay) stretches this
+            # round-trip past the pool's suspect threshold, `hang`
+            # (kind=hang) sleeps into the stall watchdog — escalated to a
+            # whole-replica loss under lost_on_stall
+            self._faults.fire("replica.slow", row=self.replica_id)
+            self._faults.fire("replica.hang", row=self.replica_id)
+            try:
+                tokens_dev.copy_to_host_async()
+            except Exception:
+                pass  # optional acceleration; np.asarray is the contract
+            with engine._tel.span("batch_decode_fetch", bucket=bucket):
+                return np.asarray(tokens_dev)  # [chunk, bucket]
+
         try:
-            for attempt in range(self.retries + 1):
-                try:
-                    self._faults.fire("batch.fetch")
-                    try:
-                        tokens_dev.copy_to_host_async()
-                    except Exception:
-                        pass  # optional acceleration; np.asarray is the contract
-                    with engine._tel.span("batch_decode_fetch", bucket=bucket):
-                        toks = np.asarray(tokens_dev)  # [chunk, bucket]
-                    error = None
-                    break
-                except Exception as e:
-                    # Exception only: a KeyboardInterrupt/SystemExit mid-fetch
-                    # must abort the process, not be retried into quarantines
-                    error = e
-                    if attempt < self.retries:
-                        engine._tel.fetch_retries.inc()
-                        time.sleep(self.retry_backoff_s * (2 ** attempt))
+            # Exception only (retry_call's contract): a KeyboardInterrupt/
+            # SystemExit mid-fetch must abort the process, not be retried
+            # into quarantines
+            toks = retry.retry_call(
+                attempt_once, self._retry_policy,
+                on_retry=lambda a, e: engine._tel.fetch_retries.inc(),
+            )
+        except Exception as e:
+            error = e
         except BaseException:
             # a KeyboardInterrupt/SystemExit mid-fetch: release the in-flight
             # accounting (unless the watchdog already took it) and propagate
@@ -1515,6 +1657,12 @@ class BatchScheduler:
             with self._cond:
                 self._cond.notify_all()
             return
+        hook = self.health_hook
+        if hook is not None and error is None:
+            # dispatch→fetch round-trip heartbeat: the pool's health state
+            # machine turns the replica SUSPECT past its threshold and
+            # back HEALTHY on a fast round-trip (server/replicas.py)
+            hook("roundtrip", sw.elapsed_s())
         if mode == "spec":
             self._deliver_spec(toks, snapshot, sw, spec_lens, error)
             self._drain_if_idle()

@@ -42,6 +42,23 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     normal unwind, co-batched survivors stay bit-identical,
                     and the preemptor still admits once the quarantined
                     row's slot frees
+``replica.crash``   whole-replica loss (ISSUE 9): fired per batched-chunk
+                    AND per prefill-chunk dispatch — a raise marks the
+                    ENTIRE scheduler lost (every in-flight request on it
+                    gets a typed ``ReplicaLost``; the serving layer
+                    requeues them through fair admission onto a surviving
+                    replica and the supervisor restarts the dead one).
+                    ``row=`` selects the REPLICA id, not a batch row
+``replica.hang``    ``kind=hang`` sleep inside the batched chunk fetch:
+                    the stall watchdog trips and — on a supervised replica
+                    (``lost_on_stall``) — escalates the stall to a whole-
+                    replica loss instead of per-row StallTimeout.
+                    ``row=`` selects the replica id
+``replica.slow``    ``kind=delay`` inside the batched chunk fetch: the
+                    dispatch round-trip exceeds the replica pool's suspect
+                    threshold and the replica turns SUSPECT (skipped for
+                    new placements until a fast round-trip clears it).
+                    ``row=`` selects the replica id
 ``tp.transfer``     raise/delay inside the transfer probe (the engine keeps
                     its last estimate instead of dying)
 ``server.send``     raise ``BrokenPipeError`` from the SSE chunk writer
@@ -118,6 +135,17 @@ class RowPreempted(RuntimeError):
     (already-sent SSE deltas are suppressed on replay)."""
 
 
+class ReplicaLost(RuntimeError):
+    """This request's WHOLE replica (engine + BatchScheduler) died — a
+    crashed dispatch, or a hang the stall watchdog escalated (ISSUE 9).
+    Like :class:`RowPreempted`, not a request failure: the serving layer
+    requeues the request through weighted-fair admission onto a surviving
+    replica and REPLAYS it — pinned sampling seed, already-sent SSE deltas
+    suppressed, stream bit-identical to an unfaulted run — while the
+    replica supervisor restarts the dead replica with jittered backoff
+    (server/replicas.py; docs/ROBUSTNESS.md failure-domain table)."""
+
+
 KINDS = ("raise", "nan", "delay", "hang", "disconnect")
 
 # The registered injection sites — the single source of truth the static
@@ -135,6 +163,9 @@ SITES = (
     "engine.spec_verify",
     "engine.paged_attn",
     "engine.preempt",
+    "replica.crash",
+    "replica.hang",
+    "replica.slow",
     "tp.transfer",
     "server.send",
 )

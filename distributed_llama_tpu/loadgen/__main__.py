@@ -76,7 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # self-host server knobs
     p.add_argument("--parallel", type=int, default=4,
-                   help="self-host serving slots (batch rows)")
+                   help="self-host serving slots (batch rows) per replica")
+    p.add_argument(
+        "--replicas", type=int, default=1,
+        help="self-host supervised data-parallel replicas (ISSUE 9): a "
+        "replica-kill chaos run composes this with --faults "
+        "'replica.crash:...' and gates on --expect-delta/--goodput-floor",
+    )
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument(
         "--server-tenants", type=str, default=None,
@@ -109,6 +115,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--isolation-bound", type=float, default=10.0)
     p.add_argument("--isolation-slack-ms", type=float, default=1000.0)
+    p.add_argument(
+        "--goodput-floor", type=float, default=None, metavar="FRACTION",
+        help="assert aggregate goodput_under_slo >= FRACTION (the "
+        "replica-kill chaos gate: a failover must replay its victims, "
+        "not shed the window)",
+    )
+    p.add_argument(
+        "--expect-delta", action="append", default=[], metavar="NAME:MIN",
+        help="assert a server counter's run delta moved at least MIN "
+        "(default 1) — proves a chaos fault actually fired, e.g. "
+        "'dllama_replica_failovers_total:1'; repeatable",
+    )
     return p
 
 
@@ -153,6 +171,7 @@ def main(argv=None) -> int:
             faults_spec=args.faults,
             faults_seed=args.faults_seed,
             admission_queue=args.admission_queue,
+            replicas=args.replicas,
         )
         url = host.url
         print(f"self-hosted server at {url}", file=sys.stderr)
@@ -196,6 +215,14 @@ def main(argv=None) -> int:
             report["checks"]["isolation"] = rep.check_isolation(
                 args.isolation, solo_results, results,
                 bound=args.isolation_bound, slack_ms=args.isolation_slack_ms,
+            )
+        if args.goodput_floor is not None:
+            report["checks"]["goodput"] = rep.check_goodput(
+                report, args.goodput_floor
+            )
+        if args.expect_delta:
+            report["checks"]["expected_deltas"] = rep.check_expected_deltas(
+                report, args.expect_delta
             )
         text = rep.dump_report(report, args.out)
         print(text)

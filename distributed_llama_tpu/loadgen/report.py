@@ -52,6 +52,11 @@ SERVER_COUNTERS = (
     "dllama_prefix_cache_misses_total",
     "dllama_faults_injected_total",
     "dllama_watchdog_stalls_total",
+    # replica-loss fault tolerance (ISSUE 9): the failover/replay ledger —
+    # a replica-kill chaos run gates on these deltas (--expect-delta)
+    "dllama_replica_failovers_total",
+    "dllama_replica_restarts_total",
+    "dllama_replayed_requests_total",
 )
 
 
@@ -293,6 +298,58 @@ def check_isolation(
             f"{p99_solo:.1f} ms + {slack_ms:.0f} ms slack)"
         ],
     }
+
+
+def check_goodput(report: dict, floor: float) -> dict:
+    """Aggregate goodput floor (the replica-kill chaos gate's teeth): the
+    fraction of SCHEDULED arrivals that completed inside their SLO must
+    not fall below ``floor`` — a failover that sheds the whole window
+    (instead of replaying its victims on survivors) fails here even when
+    every surviving stream is individually consistent."""
+    got = report.get("aggregate", {}).get("goodput_under_slo", 0.0)
+    ok = got >= floor
+    return {
+        "ok": ok,
+        "goodput_under_slo": got,
+        "floor": floor,
+        "violations": [] if ok else [
+            f"aggregate goodput {got:.3f} below the {floor:.3f} floor"
+        ],
+    }
+
+
+def check_expected_deltas(report: dict, specs: list[str]) -> dict:
+    """Gate on server-side counter movement: each spec is ``name:min`` —
+    the run's /metrics delta for ``name`` must be ≥ ``min``. This is how
+    a chaos smoke proves its fault actually FIRED (a replica-kill run
+    with zero `dllama_replica_failovers_total` movement tested nothing)."""
+    server = report.get("server") or {}
+    violations: list[str] = []
+    expected: dict[str, float] = {}
+    for spec in specs:
+        name, _, floor_s = spec.partition(":")
+        name = name.strip()
+        try:
+            floor = float(floor_s) if floor_s.strip() else 1.0
+        except ValueError:
+            # a malformed MIN is a reportable violation, not a traceback
+            # after minutes of traffic: the run's report must still land
+            violations.append(
+                f"malformed --expect-delta spec {spec!r} (want NAME:MIN)"
+            )
+            continue
+        expected[name] = floor
+        got = server.get(name)
+        if got is None:
+            violations.append(
+                f"counter {name!r} not in the report's server deltas"
+            )
+        elif got < floor:
+            violations.append(
+                f"counter {name!r} moved {got:g}, expected >= {floor:g}"
+            )
+    return {"ok": not violations, "expected": expected,
+            "violations": violations}
 
 
 def failed_checks(report: dict) -> list[str]:

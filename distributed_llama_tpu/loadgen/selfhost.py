@@ -41,6 +41,11 @@ class SelfHost:
 
     def stop(self) -> None:
         self.server.shutdown()
+        # stop replica supervision + scheduler watchdogs (a dead replica's
+        # restart loop must not outlive the run it belongs to)
+        pool = getattr(self.state, "pool", None)
+        if pool is not None:
+            pool.close()
 
 
 def start_selfhost(
@@ -55,6 +60,7 @@ def start_selfhost(
     admission_queue: int | None = None,
     deadline_ms: float | None = None,
     seed: int = 0,
+    replicas: int = 1,
 ) -> SelfHost:
     """Build the tiny synthetic model + tokenizer, construct the real
     ApiState (batched decode, prefix cache, weighted-fair admission) and
@@ -96,8 +102,19 @@ def start_selfhost(
         tenants=tenants, preempt=preempt,
         admission_queue=admission_queue, deadline_ms=deadline_ms,
         stall_timeout_s=60.0,
+        # replica-kill chaos (ISSUE 9): N supervised replicas over the
+        # SAME synthetic model file, so a failover replay on a survivor
+        # is bit-identical to the original stream; fast restart backoff
+        # keeps the dead-replica-returns window inside a CI smoke
+        replicas=replicas,
+        replica_restart_backoff_s=0.1,
     )
-    state = ApiState(engine, tok, sampler, args)
+    # each replica loads the same weights (compiled programs are shared
+    # across engines — same shapes, same static config)
+    state = ApiState(
+        engine, tok, sampler, args,
+        engine_factory=lambda: InferenceEngine(path, dtype=jnp.float32),
+    )
     server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
     server.daemon_threads = True
     threading.Thread(

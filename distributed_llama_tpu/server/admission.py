@@ -270,6 +270,27 @@ class FairAdmission:
             self._waiting -= 1
             self.admitted_total[tenant] = self.admitted_total.get(tenant, 0) + 1
 
+    def resize(self, delta: int) -> None:
+        """Grow or shrink serving capacity by ``delta`` permits — the
+        replica pool's lever (ISSUE 9): a replica declared DEAD removes
+        its slots (``_free`` may go transiently negative while the dead
+        replica's in-flight requests still hold permits; their unwinding
+        releases rebalance it), a restarted replica adds them back and
+        grants queued waiters. Capacity may reach 0 (every replica dead):
+        new requests then queue/429 until a restart succeeds."""
+        with self._cond:
+            n = self.n_slots + int(delta)
+            if n < 0:
+                raise ValueError(
+                    f"resize({delta}) would make capacity negative "
+                    f"(currently {self.n_slots})"
+                )
+            self.n_slots = n
+            self._free += int(delta)
+            if delta > 0:
+                self._grant_locked()
+            self._cond.notify_all()
+
     def release(self) -> None:
         """Return one permit and grant it onward (priority class first,
         DRR within the class)."""

@@ -55,7 +55,7 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from distributed_llama_tpu import telemetry
+from distributed_llama_tpu import retry, telemetry
 from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine.faults import DeadlineExceeded
 from distributed_llama_tpu.server.admission import (
@@ -64,6 +64,11 @@ from distributed_llama_tpu.server.admission import (
     FairAdmission,
     ServerDraining,
     parse_tenants,
+)
+from distributed_llama_tpu.server.replicas import (
+    NoPlaceableReplica,
+    Replica,
+    ReplicaPool,
 )
 from distributed_llama_tpu.telemetry import Stopwatch
 from distributed_llama_tpu.tokenizer import (
@@ -96,11 +101,17 @@ class BadRequest(ValueError):
 # weighted-fair admission machinery in server/admission.py (ISSUE 8) and
 # are re-exported above for compatibility with existing imports.
 
-# a preempted request requeues through fair admission at most this many
-# times before the server answers 503 + Retry-After: the deadline is the
-# real bound, but a deadline-less victim under sustained higher-priority
-# pressure must not requeue forever on one handler thread
+# a preempted (or replica-loss-orphaned) request requeues through fair
+# admission at most this many times before the server answers 503 +
+# Retry-After: the deadline is the real bound, but a deadline-less victim
+# under sustained higher-priority pressure (or cascading replica deaths)
+# must not requeue forever on one handler thread
 MAX_PREEMPT_REQUEUES = 3
+
+# the requeue loop's shape, in the shared retry vocabulary (ISSUE 9
+# satellite): N+1 total attempts, no sleep between them — the fair
+# admission queue IS the backpressure
+REQUEUE_POLICY = retry.BackoffPolicy(attempts=MAX_PREEMPT_REQUEUES + 1)
 
 
 @dataclasses.dataclass
@@ -148,7 +159,8 @@ class NaiveCache:
 @dataclasses.dataclass
 class StreamSlot:
     """One concurrent completion lane: an engine stream plus its chat-prefix
-    cache and (host-path) sampler. ``busy`` is guarded by ApiState._mutex."""
+    cache and (host-path) sampler. ``busy`` is guarded by the replica
+    pool's condition lock (server/replicas.py)."""
 
     stream: object  # EngineStream
     cache: NaiveCache
@@ -158,7 +170,10 @@ class StreamSlot:
 
 
 class ApiState:
-    def __init__(self, engine, tokenizer: Tokenizer, sampler: Sampler, args):
+    def __init__(
+        self, engine, tokenizer: Tokenizer, sampler: Sampler, args,
+        engine_factory=None,
+    ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.sampler = sampler  # slot 0's sampler (kept as an attribute for tests)
@@ -167,68 +182,55 @@ class ApiState:
         self.stops = stops
         template_type = getattr(args, "chat_template", None) or ChatTemplateType.UNKNOWN
         self.template = ChatTemplate(template_type, tokenizer.chat_template, stops[0])
-        # N concurrent completion lanes over one engine (each stream owns a
-        # KV cache; weights/compiled programs are shared). The reference is
-        # single-threaded by construction (dllama-api.cpp:418-423 accepts
-        # one socket at a time).
+        # N concurrent completion lanes PER REPLICA over R replicas
+        # (ISSUE 9). Each replica is an independent failure domain — its
+        # own engine, BatchScheduler slab and prefix-cache pool — behind
+        # one admission front door; within a replica the lanes share its
+        # slab (batched decode, one weight read per step). The reference
+        # is single-threaded AND single-domain by construction
+        # (dllama-api.cpp:418-423): one socket error kills everything.
         n = max(1, int(getattr(args, "parallel", 2) or 1))
-        # batched serving fast path: the N lanes share one BatchScheduler
-        # slab and coalesce into batched decode dispatches (one weight read
-        # per step for all in-flight completions). Host-path decode
-        # (--decode host) and the sp/ep backends keep independent streams.
-        self.batch = None
-        if (
+        n_replicas = max(1, int(getattr(args, "replicas", 1) or 1))
+        self._lanes = n
+        self._engine_factory = engine_factory
+        if n_replicas > 1 and engine_factory is None:
+            print(
+                "⚠️ replicas reduced to 1: no engine factory to build "
+                "(or restart) additional replicas — serve() provides one"
+            )
+            n_replicas = 1
+        # computed AFTER the factory clamp: a replicas>1 request that just
+        # collapsed to 1 must not latch the bucket-1 batched scheduler a
+        # single lane would never have chosen
+        self._batch_wanted = (
             getattr(args, "batch_decode", False)
             and getattr(args, "decode", "device") == "device"
-            and n > 1  # a single lane keeps the proven single-stream fast
-            # path: the bucket-1 batched program only adds overhead
-        ):
-            from distributed_llama_tpu.engine.batch import BatchScheduler
-
-            try:
-                self.batch = BatchScheduler(
-                    engine, n_rows=n, chunk=getattr(args, "decode_chunk", 32),
-                    stall_timeout_s=getattr(args, "stall_timeout_s", None),
-                    # paged prefix cache (ISSUE 4): repeated prompt prefixes
-                    # (system prompts, replayed conversations) skip their
-                    # matched prefill; per-request `cache: off` opts out
-                    prefix_cache=getattr(args, "prefix_cache", True),
-                    kv_pages=getattr(args, "kv_pages", None),
-                    # no falsy-or: an explicit --kv-page-size 0 must reach
-                    # the scheduler's misconfiguration diagnostic, not be
-                    # silently rewritten to the default (the PR 3
-                    # admission_queue=0 bug class)
-                    page_size=getattr(args, "kv_page_size", 64),
-                    prefill_chunk=getattr(args, "prefill_chunk", 256),
-                    # self-speculative decode (ISSUE 6): batched verify
-                    # steps with prompt-lookup drafts; 0 (the default)
-                    # keeps the proven chunked dispatch
-                    spec_draft=getattr(args, "spec_draft", 0),
-                    spec_ngram=getattr(args, "spec_ngram", 3),
-                )
-            except ValueError as e:  # backend without a batched path (sp/ep)
-                print(f"⚠️ batch decode disabled: {e}")
-        if self.batch is not None:
-            streams = [self.batch.new_stream() for _ in range(n)]
-        else:
-            streams = [engine.default_stream] + [engine.new_stream() for _ in range(n - 1)]
-        self.slots = [
-            StreamSlot(
-                s,
-                NaiveCache(),
-                sampler if i == 0 else Sampler(
-                    vocab_size=sampler.vocab_size, temperature=sampler.temperature,
-                    topp=sampler.topp, seed=sampler.seed + i,
-                ),
-            )
-            for i, s in enumerate(streams)
+            # a single lane on a single replica keeps the proven
+            # single-stream fast path (the bucket-1 batched program only
+            # adds overhead); replicas REQUIRE the scheduler — it is the
+            # failure domain being supervised
+            and (n > 1 or n_replicas > 1)
+        )
+        # replica 0 FIRST: whether the batched path exists decides whether
+        # more replicas make sense — discovering that after paying N-1
+        # engine builds (full weight loads) would waste minutes and HBM
+        replicas = [Replica(0, *self._build_replica(0, engine=engine))]
+        self.batch = replicas[0].scheduler  # compat: tests/benches poke this
+        if n_replicas > 1 and self.batch is None:
+            # the sp/ep backends have no batched path, so no supervisable
+            # scheduler: fall back to one replica rather than pretend
+            print("⚠️ replicas reduced to 1: batch decode unavailable")
+            n_replicas = 1
+        replicas += [
+            Replica(i, *self._build_replica(i)) for i in range(1, n_replicas)
         ]
-        self.cache = self.slots[0].cache  # single-stream tests poke this
-        self._mutex = threading.Lock()
+        self.cache = replicas[0].slots[0].cache  # single-stream tests poke this
         # fault tolerance (ISSUE 3): bounded admission queue, per-request
         # deadlines, request-body cap, and the SIGTERM drain flag
         aq = getattr(args, "admission_queue", None)
-        self.queue_limit = max(0, int(aq)) if aq is not None else 2 * n
+        self.queue_limit = (
+            max(0, int(aq)) if aq is not None else 2 * n * n_replicas
+        )
         mb = getattr(args, "max_body_bytes", None)  # 0 is a valid cap — no falsy-or
         self.max_body_bytes = int(mb) if mb is not None else (1 << 20)
         self.default_deadline_ms = getattr(args, "deadline_ms", None)
@@ -239,13 +241,45 @@ class ApiState:
         # weight 1 / priority 0
         self.tenants = parse_tenants(getattr(args, "tenants", None))
         self.admission = FairAdmission(
-            n, tenants=self.tenants, queue_limit=self.queue_limit
+            n * n_replicas, tenants=self.tenants, queue_limit=self.queue_limit
+        )
+        # server instrument bundle: bound BEFORE the pool so the pool's
+        # replica-state gauges land in the same registry bundle
+        self.tel = telemetry.ServerInstruments()
+        # the supervised replica pool (ISSUE 9, server/replicas.py):
+        # placement, health (healthy → suspect → dead off dispatch
+        # round-trips + the stall watchdog), capacity resize on death,
+        # and jittered-backoff restart supervision. Supervision needs the
+        # factory (a restart rebuilds the engine); without one the single
+        # replica keeps the PR 3 semantics (stall = StallTimeout, no
+        # failover) — nothing to fail over TO.
+        # no falsy-or on the replica flags: an explicit 0 is a legitimate
+        # setting (0 restart base = immediate jitter-only retries) and must
+        # not be silently rewritten to the default (the PR 3
+        # admission_queue=0 bug class)
+        suspect_s = getattr(args, "replica_suspect_s", None)
+        restart_base = getattr(args, "replica_restart_backoff_s", None)
+        self.pool = ReplicaPool(
+            self._build_replica,
+            replicas,
+            admission=self.admission,
+            tel=self.tel,
+            supervise=engine_factory is not None and self.batch is not None,
+            suspect_roundtrip_s=30.0 if suspect_s is None else float(suspect_s),
+            restart_policy=retry.BackoffPolicy(
+                attempts=retry.UNBOUNDED,
+                base_s=0.5 if restart_base is None else float(restart_base),
+                multiplier=2.0,
+                max_s=30.0,
+                jitter_s=0.5,
+            ),
         )
         if self.batch is not None and getattr(args, "preempt", True):
             # priority preemption: a queued high-priority arrival may evict
-            # the lowest-priority decode row to a clean requeue (the hook
-            # runs OUTSIDE the admission lock — see admission.acquire)
-            self.admission.preempt_hook = self.batch.preempt_below
+            # the lowest-priority decode row ON ANY LIVE REPLICA to a clean
+            # requeue (the hook runs OUTSIDE the admission lock — see
+            # admission.acquire)
+            self.admission.preempt_hook = self.pool.preempt_below
         # jittered Retry-After (ISSUE 8 satellite): a fixed value tells
         # every rejected client to come back on the same tick, and the
         # synchronized retry storm re-spikes the admission queue (loadgen's
@@ -258,14 +292,85 @@ class ApiState:
         )
         self._retry_rng = random.Random()
         self.draining = False
-        # server instrument bundle (requests / duration / in-flight / queue
-        # wait): real registry metrics when telemetry is enabled at startup,
-        # shared no-op singletons otherwise
-        self.tel = telemetry.ServerInstruments()
         # bind-once fault-injection plan (engine/faults.py): the SSE writer
         # fires the server.send site through it (kind=disconnect models a
         # client vanishing mid-stream)
         self.faults = faults.active_plan()
+
+    @property
+    def slots(self) -> list[StreamSlot]:
+        """Every replica's serving lanes, flattened (the pre-pool surface:
+        tests and shutdown paths iterate busy flags/streams through it)."""
+        return self.pool.all_slots()
+
+    def _make_scheduler(self, engine, replica_id: int):
+        """Build one replica's BatchScheduler from the serving flags, or
+        None when batching is off / the backend has no batched path."""
+        if not self._batch_wanted:
+            return None
+        from distributed_llama_tpu.engine.batch import BatchScheduler
+
+        args = self.args
+        try:
+            return BatchScheduler(
+                engine, n_rows=self._lanes,
+                chunk=getattr(args, "decode_chunk", 32),
+                stall_timeout_s=getattr(args, "stall_timeout_s", None),
+                # paged prefix cache (ISSUE 4): repeated prompt prefixes
+                # (system prompts, replayed conversations) skip their
+                # matched prefill; per-request `cache: off` opts out
+                prefix_cache=getattr(args, "prefix_cache", True),
+                kv_pages=getattr(args, "kv_pages", None),
+                # no falsy-or: an explicit --kv-page-size 0 must reach
+                # the scheduler's misconfiguration diagnostic, not be
+                # silently rewritten to the default (the PR 3
+                # admission_queue=0 bug class)
+                page_size=getattr(args, "kv_page_size", 64),
+                prefill_chunk=getattr(args, "prefill_chunk", 256),
+                # self-speculative decode (ISSUE 6): batched verify
+                # steps with prompt-lookup drafts; 0 (the default)
+                # keeps the proven chunked dispatch
+                spec_draft=getattr(args, "spec_draft", 0),
+                spec_ngram=getattr(args, "spec_ngram", 3),
+                replica_id=replica_id,
+            )
+        except ValueError as e:  # backend without a batched path (sp/ep)
+            print(f"⚠️ batch decode disabled: {e}")
+            return None
+
+    def _build_replica(self, idx: int, engine=None):
+        """Build (or REBUILD — the pool supervisor calls this under the
+        restart backoff) replica ``idx``: an engine, its scheduler, and
+        its serving lanes. Returns ``(engine, scheduler_or_None, slots)``.
+        Slot sampler seeds stay globally distinct across replicas so
+        seedless sampled requests never correlate between lanes."""
+        if engine is None:
+            if self._engine_factory is None:
+                raise RuntimeError(
+                    f"replica {idx} cannot be built: no engine factory"
+                )
+            engine = self._engine_factory()
+        sched = self._make_scheduler(engine, idx)
+        if sched is not None:
+            streams = [sched.new_stream() for _ in range(self._lanes)]
+        else:
+            streams = [engine.default_stream] + [
+                engine.new_stream() for _ in range(self._lanes - 1)
+            ]
+        base = self.sampler
+        slots = [
+            StreamSlot(
+                s,
+                NaiveCache(),
+                base if idx == 0 and i == 0 and engine is self.engine
+                else Sampler(
+                    vocab_size=base.vocab_size, temperature=base.temperature,
+                    topp=base.topp, seed=base.seed + idx * self._lanes + i,
+                ),
+            )
+            for i, s in enumerate(streams)
+        ]
+        return engine, sched, slots
 
     def begin_drain(self) -> None:
         """Stop admitting new completions (SIGTERM): queued/new requests get
@@ -283,6 +388,23 @@ class ApiState:
             0, self.retry_after_jitter_s
         )
 
+    def ready_payload(self) -> dict:
+        """The ``/readyz`` JSON body (schema: docs/OBSERVABILITY.md
+        "Readiness schema"). The plain 200/503 status contract is
+        unchanged for existing probes — the body ADDS per-replica health
+        state, queue depth, active rows and drain status for load
+        balancers that read it (ISSUE 9 satellite)."""
+        return {
+            "status": "draining" if self.draining else "ready",
+            "draining": self.draining,
+            "queue_depth": self.admission.waiting(),
+            # clamped: mid-failover the raw permit count is transiently
+            # negative (resize removed a dead replica's capacity while its
+            # victims still hold permits) — the schema promises >= 0
+            "free_slots": max(0, self.admission.free_slots()),
+            "replicas": self.pool.snapshot(),
+        }
+
     def _acquire_slot(
         self, messages: list[dict], deadline: float | None = None,
         tenant: str = DEFAULT_TENANT, priority: int = 0,
@@ -294,9 +416,10 @@ class ApiState:
         arrival may preempt a lower-priority decode row (the admission
         hook), and a queued request whose deadline expires leaves with
         DeadlineExceeded → 504 instead of burning its remaining budget in
-        line. The chosen lane is the free one whose chat prefix cache
-        reuses the most of this request (prefix affinity keeps multi-turn
-        KV reuse working under concurrency)."""
+        line. Placement then picks the lane through the replica pool:
+        best chat-prefix affinity first (multi-turn KV reuse survives
+        concurrency), least-loaded HEALTHY replica on ties — suspect
+        replicas are a fallback, dead ones never place (ISSUE 9)."""
         sw = Stopwatch()
         tel = self.tel
         try:
@@ -317,24 +440,21 @@ class ApiState:
         tel.queue_wait.observe(sw.elapsed_s())
         tel.tenant_admitted.labels(tenant=tenant).inc()
         tel.tenant_active.labels(tenant=tenant).inc()
-        with self._mutex:
-            free = [s for s in self.slots if not s.busy]
-            # primary: longest prefix reuse; tie-break: prefer an EMPTY
-            # cache so a fresh conversation does not clobber another live
-            # conversation's prefix cache when an empty lane exists
-            slot = max(
-                free,
-                key=lambda s: (s.cache.match_len(messages), 0 if s.cache.items else 1),
-            )
-            slot.busy = True
-            slot.tenant = tenant
-            return slot
+        try:
+            slot = self.pool.place(messages, deadline)
+        except BaseException:
+            # placement raced a replica death (or the deadline): give the
+            # permit back — a raised ReplicaLost re-enters the requeue
+            # loop and takes a fresh pass through fair admission
+            self.admission.release()
+            tel.tenant_active.labels(tenant=tenant).dec()
+            raise
+        slot.tenant = tenant
+        return slot
 
     def _release_slot(self, slot: StreamSlot) -> None:
         tenant = slot.tenant or DEFAULT_TENANT
-        with self._mutex:
-            slot.busy = False
-            slot.tenant = None
+        self.pool.release(slot)
         self.admission.release()
         self.tel.tenant_active.labels(tenant=tenant).dec()
 
@@ -373,16 +493,17 @@ class ApiState:
             priority = self.admission.config(tenant).priority
         if self.draining:
             raise ServerDraining("server is draining; not admitting")
-        # preemption requeue (ISSUE 8): an evicted request re-enters fair
-        # admission and RE-RUNS from its prompt — the re-run prefills
-        # through the prefix cache's published pages and (same seed)
-        # decodes bit-identically, so suppressing the first `sent` SSE
-        # deltas replays exactly the continuation the client is owed
+        # requeue-and-replay (ISSUE 8 preemption, ISSUE 9 replica loss):
+        # an evicted request — or one whose WHOLE REPLICA died — re-enters
+        # fair admission and RE-RUNS from its prompt on whatever live
+        # replica placement picks; the re-run (same pinned seed) decodes
+        # bit-identically, so suppressing the first `sent` SSE deltas
+        # replays exactly the continuation the client is owed.
         # pin the sampling seed ONCE per request, not per attempt: seedless
         # sampled requests otherwise re-derive a fresh wall-clock seed in
-        # _complete_on on every preemption requeue, and the re-run samples
-        # a DIFFERENT completion whose replayed prefix guarded_send would
-        # silently splice onto the first run's already-sent deltas
+        # _complete_on on every requeue, and the re-run samples a DIFFERENT
+        # completion whose replayed prefix guarded_send would silently
+        # splice onto the first run's already-sent deltas
         if params.get("seed") is None:
             params["seed"] = int(time.time_ns() % (1 << 31))
         sent = 0
@@ -396,11 +517,16 @@ class ApiState:
             send_chunk(data)
             sent += 1
 
-        for attempt in range(MAX_PREEMPT_REQUEUES + 1):
+        def attempt_once():
+            nonlocal skip
             skip = sent  # re-runs replay (and suppress) what was delivered
             slot = self._acquire_slot(
                 params["messages"], deadline, tenant, priority
             )
+            # the slot's OWN scheduler (its replica's), not replica 0's:
+            # request-end bookkeeping must land on the scheduler that
+            # actually served the row
+            sched = getattr(slot.stream, "scheduler", None)
             try:
                 slot.stream.deadline = deadline
                 # per-request prefix-cache opt-out (`cache: off` in the
@@ -414,21 +540,41 @@ class ApiState:
                 return self._complete_on(
                     slot, params, guarded_send, request_id, deadline
                 )
-            except faults.RowPreempted:
-                if attempt >= MAX_PREEMPT_REQUEUES:
-                    raise
-                self.tel.preempt_requeues.inc()
             finally:
                 slot.stream.deadline = None
                 slot.stream.prefix_cache_enabled = True
                 slot.stream.tenant = None
                 slot.stream.priority = None
-                if self.batch is not None:
+                if sched is not None:
                     # drop an unconsumed eviction marker (the request beat
                     # its preemption to the finish line) so it cannot leak
                     # into the row's next request
-                    self.batch.retract_preemption(slot.stream)
+                    sched.retract_preemption(slot.stream)
                 self._release_slot(slot)
+
+        def on_requeue(attempt: int, e: Exception) -> None:
+            if isinstance(e, NoPlaceableReplica):
+                # a placement bounce: nothing ran, so nothing replays —
+                # counting it would inflate replayed_requests exactly when
+                # replays are FAILING (the OBSERVABILITY.md health read
+                # compares the counter against the victim count)
+                return
+            if isinstance(e, faults.ReplicaLost):
+                # failover replay: the victim's replica died mid-flight;
+                # the next attempt places on a surviving replica. The
+                # pool's ledger increments under its lock — a failover's
+                # victims requeue CONCURRENTLY, and a lost increment would
+                # read as "victims dying at the requeue cap"
+                self.pool.count_replay()
+                self.tel.replayed_requests.inc()
+            else:
+                self.tel.preempt_requeues.inc()
+
+        return retry.retry_call(
+            attempt_once, REQUEUE_POLICY,
+            retry_on=(faults.RowPreempted, faults.ReplicaLost),
+            on_retry=on_requeue,
+        )
 
     def _complete_on(
         self, slot: StreamSlot, params: dict, send_chunk, request_id: str,
@@ -744,13 +890,14 @@ def make_handler(state: ApiState):
             elif self.path == "/readyz":
                 # readiness: admitting new work. Flips 503 on SIGTERM drain
                 # so load balancers stop routing here while in-flight
-                # completions finish
-                if state.draining:
-                    self._send_json(503, {"status": "draining"})
-                    state.tel.requests.labels(route="/readyz", status="503").inc()
-                else:
-                    self._send_json(200, {"status": "ready"})
-                    state.tel.requests.labels(route="/readyz", status="200").inc()
+                # completions finish. The body carries the per-replica
+                # health snapshot (ISSUE 9; schema in OBSERVABILITY.md) —
+                # the 200/503 contract for plain probes is unchanged
+                code = 503 if state.draining else 200
+                self._send_json(code, state.ready_payload())
+                state.tel.requests.labels(
+                    route="/readyz", status=str(code)
+                ).inc()
             elif self.path == "/metrics":
                 # Prometheus text exposition of the process-global registry
                 # (engine + server + collective instruments). Valid, possibly
@@ -947,16 +1094,22 @@ def make_handler(state: ApiState):
                         extra_headers={"Retry-After": str(state.retry_after())},
                     )
                 return "503"
-            except faults.RowPreempted as e:
-                # a preempted request re-runs transparently inside
-                # state.complete(); reaching here means it was evicted
-                # MAX_PREEMPT_REQUEUES times in a row — shed it like
-                # overload rather than spinning a handler thread forever
+            except (faults.RowPreempted, faults.ReplicaLost) as e:
+                # preempted requests and replica-loss victims re-run
+                # transparently inside state.complete(); reaching here
+                # means the request was evicted (or orphaned by dying
+                # replicas) MAX_PREEMPT_REQUEUES times in a row — shed it
+                # like overload rather than spinning a handler thread
+                # forever. Retry-After is jittered as usual.
+                kind = (
+                    "replica_lost"
+                    if isinstance(e, faults.ReplicaLost) else "preempted"
+                )
                 if sse_started:
-                    _sse_terminal_error(str(e), "preempted")
+                    _sse_terminal_error(str(e), kind)
                 else:
                     self._send_json(
-                        503, self._error_body(str(e), "preempted", rid),
+                        503, self._error_body(str(e), kind, rid),
                         request_id=rid,
                         extra_headers={"Retry-After": str(state.retry_after())},
                     )
@@ -1034,7 +1187,17 @@ def serve(args) -> None:
         faults.install(faults.parse(spec, seed=getattr(args, "faults_seed", 0)))
         print(f"⚠️ fault plan active: {spec}")
     engine, tokenizer, sampler = make_engine(args)
-    state = ApiState(engine, tokenizer, sampler, args)
+
+    def engine_factory():
+        # replica (re)builds (ISSUE 9): a fresh engine from the same flags
+        # — the restart supervisor calls this off the serving path, and
+        # the persistent compile cache (configured above) makes the re-jit
+        # a deserialization rather than a rebuild
+        return make_engine(args)[0]
+
+    state = ApiState(
+        engine, tokenizer, sampler, args, engine_factory=engine_factory
+    )
     # threaded HTTP front (GET /v1/models and queued POSTs stay responsive);
     # up to --parallel completions run concurrently on their own engine
     # streams, excess requests queue BOUNDEDLY on the slot semaphore
@@ -1061,8 +1224,32 @@ def main(argv=None) -> None:
     parser.add_argument("--port", type=int, default=9990)
     parser.add_argument(
         "--parallel", type=int, default=2,
-        help="concurrent in-flight completions (each costs one KV cache of "
-        "HBM; the reference serves exactly one, dllama-api.cpp:418-423)",
+        help="concurrent in-flight completions PER REPLICA (each costs one "
+        "KV cache of HBM; the reference serves exactly one, "
+        "dllama-api.cpp:418-423)",
+    )
+    # replica-loss fault tolerance (ISSUE 9, docs/ROBUSTNESS.md)
+    parser.add_argument(
+        "--replicas", type=int, default=1,
+        help="supervised data-parallel replicas behind one admission front "
+        "door: each is an independent engine + batch scheduler failure "
+        "domain (total slots = replicas x --parallel). A dead replica's "
+        "in-flight requests replay bit-identically on survivors while the "
+        "supervisor restarts it with jittered backoff; health rides "
+        "dispatch round-trips + the stall watchdog (/readyz reports "
+        "per-replica state)",
+    )
+    parser.add_argument(
+        "--replica-suspect-s", type=float, default=30.0,
+        help="dispatch round-trip duration past which a replica turns "
+        "SUSPECT (skipped for new placements until a fast round-trip "
+        "clears it)",
+    )
+    parser.add_argument(
+        "--replica-restart-backoff-s", type=float, default=0.5,
+        help="base restart backoff for a dead replica (exponential to "
+        "30s, entropy-jittered so restored replicas never restart in "
+        "lockstep)",
     )
     parser.add_argument(
         "--batch-decode", action=argparse.BooleanOptionalAction, default=True,

@@ -1,0 +1,430 @@
+"""Supervised data-parallel replica pool (ISSUE 9).
+
+The reference system dies wholesale when any one of its 2^n nodes drops a
+socket (reference: src/apps/dllama/dllama.cpp:418-423 — no failover path
+exists), and PRs 1–8 inherited that blast radius one level up: one engine,
+one scheduler, one process. This module generalizes the failure domain the
+codebase already handles — a *row* (quarantine, PR 3) and a *request*
+(preemption replay, PR 8) — to a whole **replica**: one
+:class:`~distributed_llama_tpu.engine.batch.BatchScheduler` plus its
+engine, its slab, its prefix-cache pool and its serving lanes.
+
+:class:`ReplicaPool` owns N replicas behind ONE admission front-end
+(server/admission.py ``FairAdmission``) and adds three things:
+
+* **Placement** — an admitted request lands on the free lane with the best
+  chat-prefix affinity, ties broken toward the least-loaded replica.
+  Suspect replicas are skipped while any healthy one has room; dead
+  replicas never place.
+* **Health** — a per-replica state machine ``healthy → suspect → dead``
+  driven by the scheduler's dispatch round-trips (a round-trip past
+  ``suspect_roundtrip_s`` turns the replica suspect; a fast one clears
+  it), the existing stall watchdog (a stall walks suspect then dead), and
+  hard losses (a crashed dispatch marks the scheduler lost outright).
+* **Supervision** — a dead replica's serving capacity leaves admission
+  (``FairAdmission.resize``), its in-flight requests carry typed
+  ``ReplicaLost`` errors that the serving layer REQUEUES through fair
+  admission and replays bit-identically on survivors (server/api.py), and
+  a supervisor thread rebuilds the replica under the shared
+  jittered-backoff policy (distributed_llama_tpu/retry.py) — restart
+  jitter is **entropy-seeded on purpose**: replicas restored from the
+  same image with a deterministic seed would retry their rebuilds in
+  lockstep, recreating the thundering herd (the ISSUE 8 Retry-After
+  lesson, applied to supervision).
+
+Lock discipline: the pool's ``_cond`` is a LEAF lock. Scheduler health
+hooks call into the pool while holding the scheduler's cond, so nothing
+here may call back into a scheduler while holding ``_cond`` (the preempt
+fan-out snapshots the scheduler list first, then calls unlocked).
+
+Everything is testable in-process under ``JAX_PLATFORMS=cpu``: replicas
+are ordinary schedulers over tiny synthetic models, and the chaos sites
+``replica.crash`` / ``replica.hang`` / ``replica.slow`` (engine/faults.py,
+``row=`` selects the replica id) drive the full failover story in
+tests/test_replicas.py and the loadgen replica-kill scenario.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from distributed_llama_tpu import retry
+from distributed_llama_tpu.engine import faults
+
+
+class NoPlaceableReplica(faults.ReplicaLost):
+    """Placement found no live replica inside its window. A subclass of
+    ReplicaLost so the serving layer's requeue loop retries it through
+    fair admission like any replica loss — but distinguishable, because a
+    placement bounce must NOT count as a replay (nothing ever ran): the
+    `dllama_replayed_requests_total` vs victim-count health read in
+    OBSERVABILITY.md depends on the counter meaning actual replays."""
+
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+# dllama_replica_state gauge encoding (docs/OBSERVABILITY.md)
+STATE_VALUES = {HEALTHY: 0, SUSPECT: 1, DEAD: 2}
+
+
+class Replica:
+    """One failure domain: an engine + (optionally) its BatchScheduler and
+    the serving slots riding on it. ``generation`` increments per rebuild
+    so health events from a replaced scheduler can never touch its
+    successor."""
+
+    __slots__ = (
+        "idx", "engine", "scheduler", "slots", "state", "generation",
+        "restarts",
+    )
+
+    def __init__(self, idx: int, engine, scheduler, slots):
+        self.idx = idx
+        self.engine = engine
+        self.scheduler = scheduler
+        self.slots = list(slots)
+        self.state = HEALTHY
+        self.generation = 0
+        self.restarts = 0
+
+    def active(self) -> int:
+        return sum(1 for s in self.slots if s.busy)
+
+
+class ReplicaPool:
+    """N supervised replicas behind one placement front door.
+
+    ``build_replica(idx)`` returns ``(engine, scheduler_or_None, slots)``
+    — the serving layer's factory (server/api.py ``_build_replica``); the
+    pool calls it again, under the restart backoff, to rebuild a dead
+    replica. ``admission`` (a FairAdmission) is resized as capacity dies
+    and returns. ``tel`` is a ServerInstruments bundle (null instruments
+    when telemetry is off). ``supervise=False`` disables the restart loop
+    and stall escalation (the standalone single-replica server keeps its
+    PR 3 StallTimeout semantics)."""
+
+    def __init__(
+        self,
+        build_replica,
+        replicas,  # list[Replica] — already built (the serving layer owns construction order)
+        admission=None,
+        tel=None,
+        supervise: bool = True,
+        suspect_roundtrip_s: float = 30.0,
+        place_timeout_s: float = 5.0,
+        restart_policy: retry.BackoffPolicy | None = None,
+        restart_seed: int | None = None,
+    ):
+        from distributed_llama_tpu import telemetry
+
+        self.build_replica = build_replica
+        self.replicas: list[Replica] = list(replicas)
+        self.admission = admission
+        self.tel = tel if tel is not None else telemetry.ServerInstruments()
+        self.supervise = bool(supervise)
+        self.suspect_roundtrip_s = float(suspect_roundtrip_s)
+        self.place_timeout_s = float(place_timeout_s)
+        self.restart_policy = restart_policy or retry.BackoffPolicy(
+            attempts=retry.UNBOUNDED, base_s=0.5, multiplier=2.0,
+            max_s=30.0, jitter_s=0.5,
+        )
+        # entropy-seeded unless a test pins it: see the module docstring
+        self._rng = (
+            random.Random(restart_seed) if restart_seed is not None
+            else random.Random()
+        )
+        self._cond = threading.Condition()
+        self._closed = False
+        # plain ledger, readable with telemetry off (the registry metrics
+        # mirror these; tests and the loadgen report read them directly)
+        self.failovers_total = 0
+        self.restarts_total = 0
+        self.replayed_total = 0
+        self.suspects_total = 0
+        self.last_failover_victims = 0
+        for r in self.replicas:
+            self._adopt(r)
+
+    # ------------------------------------------------------------------
+    # Wiring
+    # ------------------------------------------------------------------
+
+    def _adopt(self, rep: Replica) -> None:
+        """Arm a (re)built replica's scheduler with its pool identity:
+        the replica-scoped chaos sites, the health hook, and — when the
+        pool supervises — stall escalation to replica loss."""
+        sched = rep.scheduler
+        self.tel.replica_state.labels(replica=str(rep.idx)).set(
+            STATE_VALUES[rep.state]
+        )
+        if sched is None:
+            return
+        sched.replica_id = rep.idx
+        sched.lost_on_stall = self.supervise
+        gen = rep.generation
+        sched.health_hook = (
+            lambda event, value, idx=rep.idx, g=gen:
+            self._on_event(idx, g, event, value)
+        )
+
+    def close(self) -> None:
+        """Stop supervision and the replicas' watchdogs (tests; a serving
+        pool lives for the process)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        for r in self.replicas:
+            if r.scheduler is not None:
+                r.scheduler.close()
+
+    # ------------------------------------------------------------------
+    # Placement
+    # ------------------------------------------------------------------
+
+    def all_slots(self) -> list:
+        """Every replica's slots, flattened (compat surface: tests and the
+        serving layer iterate busy flags / streams through this)."""
+        return [s for r in self.replicas for s in r.slots]
+
+    def place(self, messages, deadline: float | None = None):
+        """Claim a free slot for an admitted request: best chat-prefix
+        affinity first, then the least-loaded replica, preferring an empty
+        chat cache on ties (the pre-pool slot scheduler's contract, now
+        replica-aware). Healthy replicas only while any has room; suspect
+        ones are the fallback; dead ones never place. When nothing is
+        placeable — a replica died between the admission grant and here —
+        waits briefly (bounded by ``place_timeout_s`` and the request
+        ``deadline``) and then raises :class:`faults.ReplicaLost`, which
+        the serving layer's requeue loop converts into a fresh pass
+        through fair admission."""
+        limit = time.monotonic() + self.place_timeout_s
+        if deadline is not None:
+            limit = min(limit, deadline)
+        with self._cond:
+            while True:
+                slot = self._pick_slot_locked(messages)
+                if slot is not None:
+                    slot.busy = True
+                    return slot
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    # the request's own budget ran out in line here: that
+                    # is a deadline (504), not a replica loss (503)
+                    raise faults.DeadlineExceeded(
+                        "deadline expired waiting for replica placement"
+                    )
+                if now >= limit or self._closed:
+                    raise NoPlaceableReplica(
+                        "no placeable replica: "
+                        + ", ".join(
+                            f"{r.idx}:{r.state}" for r in self.replicas
+                        )
+                    )
+                self._cond.wait(timeout=limit - now)
+
+    def _pick_slot_locked(self, messages):
+        for wanted in (HEALTHY, SUSPECT):
+            cands = [
+                (r, s)
+                for r in self.replicas
+                if r.state == wanted
+                for s in r.slots
+                if not s.busy
+            ]
+            if cands:
+                _, slot = max(
+                    cands,
+                    key=lambda rs: (
+                        rs[1].cache.match_len(messages),
+                        -rs[0].active(),
+                        0 if rs[1].cache.items else 1,
+                    ),
+                )
+                return slot
+        return None
+
+    def release(self, slot) -> None:
+        with self._cond:
+            slot.busy = False
+            slot.tenant = None
+            self._cond.notify_all()
+
+    def preempt_below(self, priority: int) -> bool:
+        """The admission preempt hook, fanned out: evict the GLOBALLY
+        lowest-priority row across live replicas — replicas are ranked by
+        their own minimum evictable priority first, so a priority-1 row
+        on replica 1 is the victim even when replica 0 also holds an
+        (evictable, but higher-priority) row. Races are tolerated: each
+        scheduler's ``preempt_below`` re-validates under its own cond,
+        and a replica whose candidate vanished simply yields to the next.
+        Scheduler calls run UNLOCKED (the scheduler cond must never nest
+        inside the pool cond — the health hooks order them the other
+        way)."""
+        with self._cond:
+            scheds = [
+                (r.idx, r.scheduler) for r in self.replicas
+                if r.state != DEAD and r.scheduler is not None
+            ]
+        ranked = []
+        for idx, sched in scheds:
+            p = sched.min_preemptible_priority()
+            if p is not None and p < priority:
+                ranked.append((p, idx, sched))
+        for _, _, sched in sorted(ranked, key=lambda t: (t[0], t[1])):
+            if sched.preempt_below(priority):
+                return True
+        return False
+
+    def count_replay(self) -> None:
+        """One failover victim replayed (called by the serving layer's
+        requeue loop). Locked: concurrent victim threads must not lose
+        increments — the replayed-vs-victims health read depends on this
+        ledger being exact."""
+        with self._cond:
+            self.replayed_total += 1
+
+    # ------------------------------------------------------------------
+    # Health state machine (hook events arrive from scheduler threads,
+    # possibly under the scheduler's cond — this side takes only _cond)
+    # ------------------------------------------------------------------
+
+    def _on_event(self, idx: int, generation: int, event: str, value: float) -> None:
+        start_restart = False
+        with self._cond:
+            rep = self.replicas[idx]
+            if rep.generation != generation:
+                return  # an echo from a replaced scheduler
+            if event == "roundtrip":
+                if value > self.suspect_roundtrip_s and rep.state == HEALTHY:
+                    self._set_state_locked(rep, SUSPECT)
+                elif value <= self.suspect_roundtrip_s and rep.state == SUSPECT:
+                    self._set_state_locked(rep, HEALTHY)
+            elif event == "stall":
+                if rep.state == HEALTHY:
+                    self._set_state_locked(rep, SUSPECT)
+            elif event == "lost":
+                if rep.state != DEAD:
+                    self._set_state_locked(rep, DEAD)
+                    self.failovers_total += 1
+                    # victims = occupied lanes on the dead replica, not the
+                    # scheduler's joined count (a request between prefill
+                    # chunks is in flight but not joined — it replays too)
+                    self.last_failover_victims = rep.active()
+                    self.tel.replica_failovers.inc()
+                    if self.admission is not None:
+                        self.admission.resize(-len(rep.slots))
+                    start_restart = self.supervise and not self._closed
+            self._cond.notify_all()
+        if start_restart:
+            threading.Thread(
+                target=self._restart_loop, args=(idx, generation),
+                name=f"dllama-replica-restart-{idx}", daemon=True,
+            ).start()
+
+    def _set_state_locked(self, rep: Replica, state: str) -> None:
+        if state == SUSPECT and rep.state != SUSPECT:
+            self.suspects_total += 1
+        rep.state = state
+        self.tel.replica_state.labels(replica=str(rep.idx)).set(
+            STATE_VALUES[state]
+        )
+
+    def mark_dead(self, idx: int, cause: str) -> None:
+        """Operator/test entry point: declare replica ``idx`` dead through
+        its scheduler's own loss path (in-flight requests get ReplicaLost,
+        the hook fires back into the pool)."""
+        rep = self.replicas[idx]
+        if rep.scheduler is not None:
+            rep.scheduler.mark_lost(cause)
+        else:
+            self._on_event(idx, rep.generation, "lost", 0.0)
+
+    # ------------------------------------------------------------------
+    # Restart supervision
+    # ------------------------------------------------------------------
+
+    def _restart_loop(self, idx: int, generation: int) -> None:
+        """Rebuild a dead replica under the jittered backoff policy. The
+        build (engine load + scheduler construction, possibly jit
+        compiles) runs OUTSIDE the pool lock; the swap-in is atomic under
+        it. A closed pool aborts the loop (the on_retry hatch)."""
+
+        def build():
+            if self._closed:
+                raise RuntimeError("pool closed; not restarting")
+            return self.build_replica(idx)
+
+        def on_retry(attempt, exc):
+            if self._closed:
+                raise exc
+            print(
+                f"⚠️ replica {idx} restart attempt {attempt + 1} failed: "
+                f"{type(exc).__name__}: {exc}"
+            )
+
+        try:
+            engine, scheduler, slots = retry.retry_call(
+                build, self.restart_policy, on_retry=on_retry, rng=self._rng,
+            )
+        except Exception as e:
+            print(f"🛑 replica {idx} restart abandoned: {e}")
+            return
+        with self._cond:
+            rep = self.replicas[idx]
+            if self._closed or rep.generation != generation:
+                dead = scheduler
+            else:
+                dead = rep.scheduler
+                rep.engine, rep.scheduler, rep.slots = (
+                    engine, scheduler, list(slots)
+                )
+                rep.generation += 1
+                rep.restarts += 1
+                self.restarts_total += 1
+                self._set_state_locked(rep, HEALTHY)
+                self._adopt(rep)
+                self.tel.replica_restarts.inc()
+                if self.admission is not None:
+                    self.admission.resize(len(rep.slots))
+            self._cond.notify_all()
+        if dead is not None:
+            dead.close()
+
+    # ------------------------------------------------------------------
+    # Introspection (/readyz, tests)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """Per-replica health for the /readyz JSON body
+        (docs/OBSERVABILITY.md "Readiness schema")."""
+        with self._cond:
+            return [
+                {
+                    "replica": r.idx,
+                    "state": r.state,
+                    "active_rows": r.active(),
+                    "slots": len(r.slots),
+                    "restarts": r.restarts,
+                }
+                for r in self.replicas
+            ]
+
+    def states(self) -> list[str]:
+        with self._cond:
+            return [r.state for r in self.replicas]
+
+    def wait_state(self, idx: int, state: str, timeout_s: float = 30.0) -> bool:
+        """Block until replica ``idx`` reaches ``state`` (tests: the
+        restarted-and-serving-again acceptance gate)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self.replicas[idx].state != state:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+            return True

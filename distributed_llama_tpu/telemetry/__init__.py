@@ -464,6 +464,32 @@ class ServerInstruments:
             "(each resumes from the prefix cache's published pages; pairs "
             "with dllama_preemptions_total on the eviction side)",
         )
+        # replica-loss fault tolerance (ISSUE 9, server/replicas.py):
+        # per-replica health plus the failover/restart/replay ledger
+        self.replica_state = gauge(
+            "dllama_replica_state",
+            "Health of each data-parallel replica in the supervised pool: "
+            "0 = healthy, 1 = suspect (skipped for new placements), "
+            "2 = dead (failing over; the supervisor is restarting it)",
+            labelnames=("replica",),
+        )
+        self.replica_failovers = counter(
+            "dllama_replica_failovers_total",
+            "Replicas declared dead by the pool (crash, or a stall the "
+            "watchdog escalated); each failover requeues every in-flight "
+            "request on the dead replica through fair admission",
+        )
+        self.replica_restarts = counter(
+            "dllama_replica_restarts_total",
+            "Dead replicas successfully rebuilt and returned to the pool "
+            "by the jittered-backoff restart supervisor",
+        )
+        self.replayed_requests = counter(
+            "dllama_replayed_requests_total",
+            "Requests replayed on a surviving replica after their replica "
+            "died mid-flight (pinned seed, sent SSE deltas suppressed — "
+            "the stream is bit-identical to an unfaulted run)",
+        )
 
 
 class SamplerInstruments:

@@ -454,3 +454,78 @@ class TestApiHardening:
                         "max_tokens": 3}) as r:
             assert json.loads(r.read())["object"] == "chat.completion"
         assert state.engine._pipeline_depth == 0
+
+    def test_sse_disconnect_during_replay_releases_exactly_once(
+        self, tmp_path
+    ):
+        """Regression (ISSUE 9 satellite): a client disconnect DURING a
+        preemption/failover REPLAY — attempt 2+ of the requeue loop, after
+        guarded_send started suppressing the already-sent deltas — must
+        release the replayed row and decrement the in-flight accounting
+        exactly once. The pre-replay disconnect path above cannot catch a
+        double-release: the replay holds a SECOND acquire whose unwind is
+        the one under test (a double admission.release() raises
+        RuntimeError; a leak leaves free_slots short)."""
+        from tests.test_faults import make_state
+
+        state = make_state(tmp_path, "replaydisc", parallel=2, batch=True)
+        assert state.batch is not None
+        # a prompt that streams well past two deltas (the replay must
+        # still have NEW deltas to send after the suppressed prefix)
+        prompt = None
+        for cand in ("tell me a very long story",
+                     "alpha bravo charlie delta echo",
+                     "hello world hello world"):
+            out = state.complete(
+                {"messages": [{"role": "user", "content": cand}],
+                 "max_tokens": 30},
+                lambda s: None,
+            )
+            if out["usage"]["completion_tokens"] >= 12:
+                prompt = cand
+                break
+        assert prompt is not None
+        for slot in state.slots:
+            slot.stream.reset()
+            slot.cache.clear()
+        calls = []
+
+        def send(data):
+            # call 1: the first delta of attempt 1 — trigger a preemption
+            # so the request requeues and REPLAYS (the suppressed replay
+            # deltas never reach this callback). call 2: the first NEW
+            # delta of the replay — the client is gone.
+            calls.append(data)
+            if len(calls) == 1:
+                assert state.batch.preempt_below(10)
+            elif len(calls) == 2:
+                raise BrokenPipeError("client went away mid-replay")
+
+        with pytest.raises(BrokenPipeError):
+            state.complete(
+                {"stream": True, "max_tokens": 30,
+                 "messages": [{"role": "user", "content": prompt}]},
+                send,
+            )
+        assert len(calls) == 2  # the disconnect WAS during the replay
+        assert state.batch.preempted_total == 1
+        # exactly-once release: every lane free, every permit back (a
+        # double release would have raised out of _release_slot; a missed
+        # one leaves free_slots < n and the acquire loop below hangs a
+        # lane short)
+        assert all(not s.busy for s in state.slots)
+        assert state.admission.free_slots() == len(state.slots)
+        for _ in range(len(state.slots)):
+            state.admission.acquire("test")
+        for _ in range(len(state.slots)):
+            state.admission.release()
+        assert not any(s._joined for s in state.batch._streams)
+        assert state.batch._pending is None and not state.batch._fetching
+        assert state.engine._pipeline_depth == 0
+        # no leaked preemption marker: the row serves the next request
+        out = state.complete(
+            {"messages": [{"role": "user", "content": "again"}],
+             "max_tokens": 3},
+            lambda s: None,
+        )
+        assert out["object"] == "chat.completion"

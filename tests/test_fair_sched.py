@@ -406,7 +406,12 @@ class TestServingFairness:
 # model finishes streaming into the socket buffer before the preemptor's
 # POST even parses, and preempt_below finds no active victims. A delay
 # fault injects NO data corruption, so the bit-identity assertions stand.
-_SLOW_DECODE = "batch.fetch:kind=delay,delay_ms=30,count=-1"
+# 60 ms (was 30): a victim the baseline probe only guarantees >= 24 tokens
+# lives >= 24/4 x 60 = 360 ms past its first delta — under full-suite CPU
+# contention the 30 ms floor (~180 ms) occasionally let a short victim
+# finish before the preemptor's POST landed, and the hook found no one
+# to evict (observed once in a loaded tier-1 run).
+_SLOW_DECODE = "batch.fetch:kind=delay,delay_ms=60,count=-1"
 
 
 @pytest.mark.chaos

@@ -662,3 +662,82 @@ class TestLifecycle:
             assert time.monotonic() - t0 < 5  # the cap held
         finally:
             state.admission.release()
+
+    def test_drain_racing_failover_replay_never_hangs(self, tmp_path):
+        """ISSUE 9 satellite: ``begin_drain`` racing an in-progress
+        failover replay. A replica dies mid-decode; the instant the
+        failover lands, SIGTERM starts the drain — so the victims' replays
+        re-enter fair admission RACING the drain gate. Contract: every
+        stream either completes (its replay beat the gate, bit-identical)
+        or ends with a clean terminal SSE error (draining/replica_lost,
+        the 503-with-Retry-After class) — and the drain itself finishes
+        well inside its cap: no permit leaks, no hung handler thread."""
+        from tests.test_fair_sched import SseStream
+        from tests.test_replicas import (
+            _SLOW,
+            _one_long_prompt,
+            make_replica_state,
+        )
+
+        clean = make_replica_state(tmp_path, "drclean", replicas=2, parallel=2)
+        url, server = serve_state(clean)
+        try:
+            prompt, baseline = _one_long_prompt(url)
+        finally:
+            server.shutdown()
+            clean.pool.close()
+
+        faults.install(faults.parse(
+            f"replica.crash:kind=raise,row=0,after=16,count=1;{_SLOW}"
+        ))
+        state = make_replica_state(
+            tmp_path, "drainrace", replicas=2, parallel=2
+        )
+        url, server = serve_state(state)
+
+        down = threading.Event()
+
+        class StubServer:
+            def shutdown(self):
+                down.set()
+
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            install_sigterm_drain(state, StubServer(), timeout_s=20.0)
+            body = {"messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": 96}
+            streams = [SseStream(url, dict(body)) for _ in range(4)]
+            firsts = [s.read_first_delta() for s in streams]
+            assert all(firsts)  # all four mid-decode
+            deadline = time.monotonic() + 30
+            while (
+                state.pool.failovers_total == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+            assert state.pool.failovers_total == 1
+            signal.raise_signal(signal.SIGTERM)  # the race: drain begins
+            # while the victims' replays are re-entering admission
+            outcomes = []
+            for s, first in zip(streams, firsts):
+                rest = s.read_rest()
+                outcomes.append((s.error_type, first + rest))
+            for err, text in outcomes:
+                if err is None:
+                    # completed through the race: bit-identical contract
+                    assert text == baseline
+                else:
+                    # bounced by the gate: a CLEAN typed terminal event,
+                    # never a hang or a silent truncation
+                    assert err in ("draining", "replica_lost"), outcomes
+            # the drain finished WELL inside its 20s cap (a hung replay
+            # would hold its permit until the cap fires the shutdown
+            # late) — and every permit came home
+            assert down.wait(timeout=15), "drain hung past its window"
+            assert (
+                state.admission.free_slots() == state.admission.n_slots
+            )
+        finally:
+            signal.signal(signal.SIGTERM, old)
+            server.shutdown()
+            state.pool.close()

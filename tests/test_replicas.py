@@ -1,0 +1,580 @@
+"""Replica-loss fault tolerance (ISSUE 9): the supervised data-parallel
+replica pool, health-checked failover, and bit-identical request replay.
+
+Three layers, mirroring the subsystem:
+
+* :class:`ReplicaPool` units — placement (affinity, least-loaded, the
+  healthy/suspect/dead ladder), the health state machine, capacity resize
+  on death/restart, and the generation guard (deterministic: fake replicas,
+  no engines).
+* Serving-level failover over real HTTP — the acceptance criterion: B=4
+  requests split across 2 replicas, an injected ``replica.crash``
+  mid-decode, every victim completing on the survivor with a byte-identical
+  greedy stream (replayed SSE deltas suppressed — zero duplicates), healthy
+  streams untouched, counters matching the victim count, and the dead
+  replica restarted and serving again within the test.
+* The health signals — ``replica.slow`` walking healthy→suspect→healthy,
+  ``replica.hang`` escalating the stall watchdog to a failover, and the
+  ``/readyz`` JSON schema.
+
+Everything runs on tiny seeded synthetic models under JAX_PLATFORMS=cpu
+(tier-1 safe); the ``chaos`` marker tags the HTTP chaos classes.
+"""
+
+import threading
+import time
+import types
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu import retry
+from distributed_llama_tpu.engine import InferenceEngine, faults
+from distributed_llama_tpu.server.admission import FairAdmission
+from distributed_llama_tpu.server.api import ApiState
+from distributed_llama_tpu.server.replicas import (
+    DEAD,
+    HEALTHY,
+    SUSPECT,
+    NoPlaceableReplica,
+    Replica,
+    ReplicaPool,
+)
+
+from tests.model_utils import random_tensors, tiny_spec, write_model_file
+from tests.test_faults import get, post_raw, serve_state
+from tests.test_fair_sched import SseStream
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ----------------------------------------------------------------------
+# Pool units (fake replicas: no engines, deterministic)
+# ----------------------------------------------------------------------
+
+
+class FakeCache:
+    def __init__(self, match=0, items=()):
+        self._match = match
+        self.items = list(items)
+
+    def match_len(self, messages):
+        return self._match
+
+
+def fake_slot(match=0, items=()):
+    return types.SimpleNamespace(
+        busy=False, tenant=None, cache=FakeCache(match, items)
+    )
+
+
+def fake_pool(n_replicas=2, lanes=2, admission=None, supervise=False,
+              **kw):
+    built = []
+
+    def build(idx):
+        built.append(idx)
+        return None, None, [fake_slot() for _ in range(lanes)]
+
+    replicas = [
+        Replica(i, None, None, [fake_slot() for _ in range(lanes)])
+        for i in range(n_replicas)
+    ]
+    pool = ReplicaPool(
+        build, replicas, admission=admission, supervise=supervise,
+        restart_policy=retry.BackoffPolicy(attempts=3, base_s=0.0),
+        restart_seed=0, **kw,
+    )
+    pool._built = built  # test hook
+    return pool
+
+
+class TestPoolPlacement:
+    def test_least_loaded_wins_without_affinity(self):
+        pool = fake_pool()
+        pool.replicas[0].slots[0].busy = True  # replica 0 carries load
+        slot = pool.place([{"role": "user", "content": "x"}])
+        assert slot in pool.replicas[1].slots  # least-loaded replica
+        assert slot.busy
+
+    def test_affinity_beats_load(self):
+        pool = fake_pool()
+        pool.replicas[0].slots[0].busy = True
+        pool.replicas[0].slots[1].cache = FakeCache(match=3, items=["x"])
+        slot = pool.place([{"role": "user", "content": "x"}])
+        # the matching cache wins even though replica 0 is busier
+        assert slot is pool.replicas[0].slots[1]
+
+    def test_suspect_is_fallback_dead_never_places(self):
+        pool = fake_pool()
+        with pool._cond:
+            pool._set_state_locked(pool.replicas[0], SUSPECT)
+        slot = pool.place([])
+        assert slot in pool.replicas[1].slots  # healthy preferred
+        for s in pool.replicas[1].slots:
+            s.busy = True
+        slot2 = pool.place([])
+        assert slot2 in pool.replicas[0].slots  # suspect fallback
+        with pool._cond:
+            pool._set_state_locked(pool.replicas[0], DEAD)
+        for s in pool.replicas[0].slots:
+            s.busy = False
+        pool.place_timeout_s = 0.05
+        with pytest.raises(NoPlaceableReplica):
+            pool.place([])  # dead replica's free slots never place
+
+    def test_place_deadline_is_504_not_replica_lost(self):
+        # a request whose budget expires in the placement wait is a
+        # DEADLINE (504), not a replica loss (503) — and must never be
+        # counted as a replay
+        pool = fake_pool()
+        for r in pool.replicas:
+            for s in r.slots:
+                s.busy = True
+        with pytest.raises(faults.DeadlineExceeded):
+            pool.place([], deadline=time.monotonic() - 0.01)
+
+    def test_release_wakes_a_placement_waiter(self):
+        pool = fake_pool(n_replicas=1, lanes=1)
+        held = pool.place([])
+        pool.place_timeout_s = 5.0
+        got = []
+
+        def waiter():
+            got.append(pool.place([]))
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        pool.release(held)
+        t.join(timeout=5)
+        assert not t.is_alive() and got and got[0].busy
+
+
+class TestPoolHealth:
+    def test_roundtrip_walks_suspect_and_back(self):
+        pool = fake_pool(suspect_roundtrip_s=1.0)
+        rep = pool.replicas[0]
+        pool._on_event(0, rep.generation, "roundtrip", 2.5)
+        assert rep.state == SUSPECT
+        assert pool.suspects_total == 1
+        pool._on_event(0, rep.generation, "roundtrip", 0.1)
+        assert rep.state == HEALTHY
+
+    def test_stall_marks_suspect_lost_marks_dead_and_resizes(self):
+        adm = FairAdmission(4)
+        pool = fake_pool(admission=adm)
+        rep = pool.replicas[0]
+        pool._on_event(0, rep.generation, "stall", 1.0)
+        assert rep.state == SUSPECT
+        rep.slots[0].busy = True  # one in-flight victim
+        pool._on_event(0, rep.generation, "lost", 1.0)
+        assert rep.state == DEAD
+        assert pool.failovers_total == 1
+        assert pool.last_failover_victims == 1
+        assert adm.n_slots == 2  # the dead replica's capacity left
+
+    def test_supervised_loss_restarts_and_restores_capacity(self):
+        adm = FairAdmission(4)
+        pool = fake_pool(admission=adm, supervise=True)
+        rep = pool.replicas[0]
+        old_slots = rep.slots
+        pool._on_event(0, rep.generation, "lost", 0.0)
+        assert pool.wait_state(0, HEALTHY, timeout_s=10)
+        assert pool._built == [0]  # the factory rebuilt replica 0
+        assert rep.generation == 1 and rep.restarts == 1
+        assert pool.restarts_total == 1
+        assert rep.slots is not old_slots
+        assert adm.n_slots == 4  # capacity restored
+
+    def test_generation_guard_drops_echoes_from_replaced_scheduler(self):
+        pool = fake_pool(supervise=True)
+        rep = pool.replicas[0]
+        pool._on_event(0, rep.generation, "lost", 0.0)
+        assert pool.wait_state(0, HEALTHY, timeout_s=10)
+        # a late event carrying the DEAD scheduler's generation 0
+        pool._on_event(0, 0, "lost", 0.0)
+        assert rep.state == HEALTHY  # ignored
+        assert pool.failovers_total == 1
+
+    def test_closed_pool_does_not_restart(self):
+        pool = fake_pool(supervise=True)
+        pool.close()
+        pool._on_event(0, pool.replicas[0].generation, "lost", 0.0)
+        time.sleep(0.1)
+        assert pool._built == []
+        assert pool.replicas[0].state == DEAD
+
+    def test_resize_supports_zero_capacity_and_regrowth(self):
+        adm = FairAdmission(2)
+        adm.acquire("a")
+        adm.resize(-2)  # both slots' replica died; one permit in flight
+        assert adm.n_slots == 0
+        assert adm.free_slots() == -1
+        adm.release()  # the victim unwinds
+        assert adm.free_slots() == 0
+        adm.resize(2)  # restart restored the capacity
+        assert adm.free_slots() == 2
+        with pytest.raises(ValueError):
+            adm.resize(-3)
+
+    def test_malformed_expect_delta_is_a_violation_not_a_crash(self):
+        from distributed_llama_tpu.loadgen.report import (
+            check_expected_deltas,
+        )
+
+        chk = check_expected_deltas({"server": {"x": 1.0}}, ["x:one", "x:1"])
+        assert not chk["ok"]
+        assert any("malformed" in v for v in chk["violations"])
+        assert chk["expected"] == {"x": 1.0}  # the valid spec still ran
+
+    def test_replica_metrics_have_enabled_mode_coverage(self):
+        # the null-instrument caveat (telemetry/__init__.py): labelled
+        # sites validate label NAMES only when telemetry is enabled
+        from distributed_llama_tpu import telemetry
+
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            pool = fake_pool(supervise=False)
+            pool._on_event(0, 0, "lost", 0.0)
+            text = telemetry.prometheus_text()
+            assert 'dllama_replica_state{replica="0"} 2' in text
+            assert 'dllama_replica_state{replica="1"} 0' in text
+            assert "dllama_replica_failovers_total 1" in text
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+# ----------------------------------------------------------------------
+# Serving-level failover over real HTTP (the acceptance criterion)
+# ----------------------------------------------------------------------
+
+
+def make_replica_state(tmp_path, name, *, replicas=2, parallel=2,
+                       max_seq=192, **extra):
+    """A replica-enabled ApiState over one tiny synthetic model file: every
+    replica (and every restart) loads the SAME weights, which is what makes
+    a failover replay byte-identical to the original stream."""
+    from distributed_llama_tpu.formats.tokenizer_file import (
+        TokenizerData,
+        write_tokenizer_file,
+    )
+    from distributed_llama_tpu.tokenizer import Sampler, Tokenizer
+
+    from tests.test_tokenizer import make_sentencepiece_like_tokenizer
+
+    base = make_sentencepiece_like_tokenizer()
+    spec = tiny_spec(seq_len=max_seq, vocab_size=base.vocab_size)
+    model_path = str(tmp_path / f"{name}.m")
+    write_model_file(model_path, spec, random_tensors(spec, seed=0))
+    data = TokenizerData(
+        vocab=base.vocab, scores=base.scores, bos_id=1, eos_id=2,
+        chat_eos_id=2,
+        chat_template="{{bos_token}}{% for m in messages %}<|im_start|>...{% endfor %}",
+    )
+    tok_path = str(tmp_path / f"{name}.t")
+    with open(tok_path, "wb") as f:
+        write_tokenizer_file(f, data)
+    engine = InferenceEngine(model_path, dtype=jnp.float32)
+    tokenizer = Tokenizer.from_file(tok_path)
+    sampler = Sampler(
+        vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, seed=1
+    )
+    args = types.SimpleNamespace(
+        temperature=0.0, topp=0.9, seed=1, chat_template=None,
+        parallel=parallel, replicas=replicas, batch_decode=True,
+        decode="device", decode_chunk=4, replica_restart_backoff_s=0.05,
+        **extra,
+    )
+    state = ApiState(
+        engine, tokenizer, sampler, args,
+        engine_factory=lambda: InferenceEngine(model_path, dtype=jnp.float32),
+    )
+    # fast deterministic restarts: the acceptance gate waits for the dead
+    # replica to return within the test
+    state.pool.restart_policy = retry.BackoffPolicy(
+        attempts=retry.UNBOUNDED, base_s=0.05
+    )
+    return state
+
+
+def _one_long_prompt(url, min_tokens=24):
+    for cand in (
+        "tell me a very long story",
+        "alpha bravo charlie delta echo",
+        "hello world hello world",
+        "the quick brown fox jumps",
+        "one two three four five six",
+    ):
+        status, _, body = post_raw(
+            url, {"messages": [{"role": "user", "content": cand}],
+                  "max_tokens": 96},
+        )
+        assert status == 200
+        if body["usage"]["completion_tokens"] >= min_tokens:
+            return cand, body["choices"][0]["message"]["content"]
+    raise AssertionError("no candidate prompt streams long enough")
+
+
+# every batched fetch on BOTH replicas sleeps, stretching the decode into
+# a window the crash reliably lands inside while all four victims-to-be
+# are mid-stream; a delay injects no corruption, so bit-parity stands
+_SLOW = "batch.fetch:kind=delay,delay_ms=25,count=-1"
+
+
+@pytest.mark.chaos
+class TestReplicaFailover:
+    def test_crash_mid_decode_replays_bit_identical_and_restarts(
+        self, tmp_path
+    ):
+        """The ISSUE 9 acceptance test: 4 requests across 2 replicas, an
+        injected replica.crash mid-decode on replica 0 — (a) victims
+        complete on the survivor byte-identically with zero duplicate SSE
+        deltas, (b) healthy streams bit-identical throughout, (c) the
+        failover/replay counters match the victim count, (d) the dead
+        replica restarts and serves again within the test."""
+        clean = make_replica_state(tmp_path, "clean", replicas=2, parallel=2)
+        assert len(clean.pool.replicas) == 2
+        assert clean.admission.n_slots == 4
+        url, server = serve_state(clean)
+        try:
+            prompt, baseline = _one_long_prompt(url)
+            # an equal-length clean baseline for the post-restart probe
+            # (a shorter run is NOT a string prefix of a longer one: a
+            # multi-byte UTF-8 sequence cut at the token limit decodes
+            # to replacement chars)
+            _, _, b8 = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 8},
+            )
+            baseline8 = b8["choices"][0]["message"]["content"]
+        finally:
+            server.shutdown()
+            clean.pool.close()
+
+        # chaos: crash replica 0 (row= selects the REPLICA) once both its
+        # lanes are deep in decode — after=16 site hits lands past the
+        # last placement (the SSE streams connect serially, each behind
+        # its first delta) but well inside the ~24 delayed chunks each
+        # stream still has to decode
+        faults.install(faults.parse(
+            f"replica.crash:kind=raise,row=0,after=16,count=1;{_SLOW}"
+        ))
+        state = make_replica_state(tmp_path, "chaos", replicas=2, parallel=2)
+        url, server = serve_state(state)
+        try:
+            body = {"messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": 96}
+            streams = [SseStream(url, dict(body)) for _ in range(4)]
+            texts = [
+                s.read_first_delta() + s.read_rest() for s in streams
+            ]
+            assert all(s.error_type is None for s in streams), [
+                s.error_type for s in streams
+            ]
+            # (a)+(b): every stream — the survivor pair AND the replayed
+            # victims — is byte-identical to the uncontended baseline; a
+            # duplicated (or wrongly-suppressed) replay delta would break
+            # the equality
+            assert texts == [baseline] * 4
+            # (c): one failover; every in-flight victim on the dead
+            # replica was replayed, and nothing else
+            pool = state.pool
+            assert pool.failovers_total == 1
+            assert pool.last_failover_victims == 2
+            assert pool.replayed_total == pool.last_failover_victims
+            # (d): the supervisor brings replica 0 back...
+            assert pool.wait_state(0, HEALTHY, timeout_s=60)
+            assert pool.restarts_total == 1
+            assert state.admission.n_slots == 4  # capacity restored
+            # ...and it actually serves: pin replica 1's lanes busy so
+            # placement MUST choose the restarted replica
+            for s in pool.replicas[1].slots:
+                s.busy = True
+            try:
+                status, _, body2 = post_raw(
+                    url, {"messages": [{"role": "user", "content": prompt}],
+                          "max_tokens": 8},
+                )
+                assert status == 200
+                assert body2["choices"][0]["message"]["content"] == baseline8
+            finally:
+                for s in pool.replicas[1].slots:
+                    s.busy = False
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_hang_escalates_watchdog_to_failover(self, tmp_path):
+        """replica.hang: a hung chunk fetch trips the stall watchdog, which
+        — on a supervised replica — escalates to a whole-replica loss: the
+        victim REPLAYS on the survivor (not StallTimeout→500), walking the
+        health ladder suspect→dead on the way."""
+        clean = make_replica_state(
+            tmp_path, "hclean", replicas=2, parallel=2
+        )
+        url, server = serve_state(clean)
+        try:
+            prompt, _ = _one_long_prompt(url)
+            # the equal-length clean baseline (string-prefix comparisons
+            # break on UTF-8 sequences cut at the token limit)
+            _, _, b48 = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 48},
+            )
+            baseline = b48["choices"][0]["message"]["content"]
+        finally:
+            server.shutdown()
+            clean.pool.close()
+
+        faults.install(faults.parse(
+            "replica.hang:kind=hang,delay_ms=2000,row=0,after=2,count=1;"
+            + _SLOW
+        ))
+        state = make_replica_state(
+            tmp_path, "hang", replicas=2, parallel=2,
+            stall_timeout_s=0.4,
+        )
+        url, server = serve_state(state)
+        try:
+            status, _, body = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 48}, timeout=120,
+            )
+            assert status == 200  # replayed, not 500
+            assert body["choices"][0]["message"]["content"] == baseline
+            pool = state.pool
+            assert pool.failovers_total == 1
+            assert pool.suspects_total >= 1  # the watchdog's "stall" step
+            assert pool.replayed_total >= 1
+            assert pool.wait_state(0, HEALTHY, timeout_s=60)
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_slow_roundtrip_marks_suspect_then_recovers(self, tmp_path):
+        """replica.slow: one delayed dispatch round-trip past the suspect
+        threshold turns the replica SUSPECT; the next fast round-trip
+        clears it. No requests are harmed."""
+        faults.install(faults.parse(
+            "replica.slow:kind=delay,delay_ms=300,row=0,after=1,count=1"
+        ))
+        state = make_replica_state(
+            tmp_path, "slow", replicas=2, parallel=2,
+            replica_suspect_s=0.15,
+        )
+        url, server = serve_state(state)
+        try:
+            status, _, _ = post_raw(
+                url, {"messages": [{"role": "user", "content": "hello"}],
+                      "max_tokens": 24},
+            )
+            assert status == 200
+            pool = state.pool
+            assert pool.suspects_total >= 1  # the slow round-trip bit
+            assert pool.failovers_total == 0  # slow is not dead
+            # the same request's later (fast) chunks already recovered it
+            assert pool.replicas[0].state == HEALTHY
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+
+class TestPoolPreemptionFanout:
+    def test_evicts_the_globally_lowest_priority_victim(self, tmp_path):
+        """The pool-wide preempt hook must evict the GLOBALLY lowest-
+        priority row, not the first replica's local minimum: with a
+        priority-3 row on replica 0 and a priority-1 row on replica 1, a
+        priority-5 arrival evicts the priority-1 row (the PR 8 single-
+        scheduler contract, 'unchanged over the whole pool')."""
+        state = make_replica_state(tmp_path, "fanout", replicas=2, parallel=2)
+        r0 = state.pool.replicas[0].slots[0].stream
+        r1 = state.pool.replicas[1].slots[0].stream
+        r0.priority = 3
+        r1.priority = 1
+        try:
+            assert state.pool.preempt_below(5)
+            assert isinstance(r1._fetch_error, faults.RowPreempted)
+            assert r0._fetch_error is None  # the higher-priority row lives
+            # a second eviction takes the next-lowest (replica 0's row)
+            assert state.pool.preempt_below(5)
+            assert isinstance(r0._fetch_error, faults.RowPreempted)
+        finally:
+            r0.priority = None
+            r1.priority = None
+            state.pool.close()
+
+
+class TestPlacementBounceAccounting:
+    def test_placement_bounce_requeues_without_counting_replays(
+        self, tmp_path
+    ):
+        """A NoPlaceableReplica (placement found no live replica) retries
+        through fair admission like any ReplicaLost — but the replay
+        counters must NOT move: nothing ran, so nothing replayed.
+        Counting bounces would inflate `dllama_replayed_requests_total`
+        exactly when replays are FAILING, inverting the
+        replayed-vs-victims health read in OBSERVABILITY.md."""
+        assert issubclass(NoPlaceableReplica, faults.ReplicaLost)
+        state = make_replica_state(tmp_path, "bounce", replicas=1, parallel=2)
+        state.pool.place = lambda messages, deadline=None: (_ for _ in ()).throw(
+            NoPlaceableReplica("every replica down")
+        )
+        with pytest.raises(faults.ReplicaLost):
+            state.complete(
+                {"messages": [{"role": "user", "content": "x"}],
+                 "max_tokens": 2},
+                lambda s: None,
+            )
+        assert state.pool.replayed_total == 0  # bounces are not replays
+        # every bounced attempt gave its admission permit back
+        assert state.admission.free_slots() == state.admission.n_slots
+        state.pool.close()
+
+
+class TestReadyzSchema:
+    def test_readyz_json_body_and_drain_contract(self, tmp_path):
+        state = make_replica_state(tmp_path, "ready", replicas=2, parallel=2)
+        url, server = serve_state(state)
+        try:
+            import json as _json
+
+            status, raw = get(url, "/readyz")
+            assert status == 200
+            body = _json.loads(raw)
+            assert body["status"] == "ready" and body["draining"] is False
+            assert body["queue_depth"] == 0
+            assert body["free_slots"] == 4
+            assert [r["replica"] for r in body["replicas"]] == [0, 1]
+            assert all(r["state"] == "healthy" for r in body["replicas"])
+            assert all(
+                r["slots"] == 2 and r["active_rows"] == 0 and
+                r["restarts"] == 0
+                for r in body["replicas"]
+            )
+            # a dead replica shows up in the body (and 200 holds: the
+            # pool is degraded, not draining). Supervision off first: a
+            # fast restart must not race the snapshot read
+            state.pool.supervise = False
+            state.pool.mark_dead(1, "test")
+            status, raw = get(url, "/readyz")
+            assert status == 200
+            body = _json.loads(raw)
+            assert body["replicas"][1]["state"] == "dead"
+            assert body["free_slots"] == 2
+            # drain flips the status code exactly as before, body agrees
+            state.begin_drain()
+            status, raw = get(url, "/readyz")
+            assert status == 503
+            body = _json.loads(raw)
+            assert body["status"] == "draining" and body["draining"] is True
+        finally:
+            server.shutdown()
+            state.pool.close()

@@ -1,0 +1,159 @@
+"""Unit tests for the shared retry/backoff helper (ISSUE 9 satellite):
+the one definition of "what does attempt N wait" behind the batch
+scheduler's transient retries, the serving layer's requeue loop, and the
+replica supervisor's restart loop."""
+
+import random
+
+import pytest
+
+from distributed_llama_tpu.retry import UNBOUNDED, BackoffPolicy, retry_call
+
+
+class TestBackoffPolicy:
+    def test_exponential_progression_and_cap(self):
+        p = BackoffPolicy(attempts=10, base_s=0.05, multiplier=2.0, max_s=0.3)
+        assert [p.delay_s(i) for i in range(5)] == [
+            0.05, 0.1, 0.2, 0.3, 0.3  # capped at max_s
+        ]
+
+    def test_matches_the_old_batch_scheduler_idiom(self):
+        # the engine/batch.py loops slept retry_backoff_s * 2**attempt —
+        # the policy must reproduce that schedule exactly (bit-for-bit
+        # backoff parity is what makes the extraction a refactor)
+        p = BackoffPolicy(attempts=3, base_s=0.05)
+        assert [p.delay_s(i) for i in range(2)] == [
+            0.05 * (2**i) for i in range(2)
+        ]
+
+    def test_jitter_is_seeded_and_bounded(self):
+        p = BackoffPolicy(attempts=5, base_s=1.0, jitter_s=0.5)
+        a = [p.delay_s(0, random.Random(7)) for _ in range(8)]
+        b = [p.delay_s(0, random.Random(7)) for _ in range(8)]
+        assert a == b  # same seed, same draws
+        rng = random.Random(3)
+        ds = [p.delay_s(0, rng) for _ in range(64)]
+        assert all(1.0 <= d <= 1.5 for d in ds)
+        assert len(set(ds)) > 1  # jitter actually varies
+        # no rng = no jitter (deterministic callers simply omit it)
+        assert p.delay_s(0) == 1.0
+
+    def test_huge_attempt_indices_saturate_instead_of_overflowing(self):
+        # float**int raises OverflowError past ~1.8e308: an UNBOUNDED
+        # supervision loop (a replica whose rebuild fails for hours) must
+        # keep waiting max_s at attempt 5000, not die of arithmetic
+        p = BackoffPolicy(attempts=UNBOUNDED, base_s=0.5, max_s=30.0)
+        assert p.delay_s(1024) == 30.0
+        assert p.delay_s(5000) == 30.0
+
+    def test_more_counts_total_attempts(self):
+        p = BackoffPolicy(attempts=3)
+        assert [p.more(i) for i in (0, 1, 2, 3)] == [True, True, True, False]
+        u = BackoffPolicy(attempts=UNBOUNDED)
+        assert u.more(10_000)
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(attempts=0),
+            dict(attempts=-2),
+            dict(attempts=1, base_s=-0.1),
+            dict(attempts=1, multiplier=0.5),
+            dict(attempts=1, jitter_s=-1.0),
+        ],
+    )
+    def test_rejects_garbage(self, kw):
+        with pytest.raises(ValueError):
+            BackoffPolicy(**kw)
+
+
+class TestRetryCall:
+    def test_success_first_try_no_sleep(self):
+        slept = []
+        out = retry_call(
+            lambda: 42, BackoffPolicy(attempts=3, base_s=1.0),
+            sleep=slept.append,
+        )
+        assert out == 42 and slept == []
+
+    def test_retries_then_succeeds_with_backoff_schedule(self):
+        calls, slept, notes = [], [], []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError(f"boom {len(calls)}")
+            return "ok"
+
+        out = retry_call(
+            fn, BackoffPolicy(attempts=4, base_s=0.05),
+            on_retry=lambda a, e: notes.append((a, str(e))),
+            sleep=slept.append,
+        )
+        assert out == "ok"
+        assert slept == [0.05, 0.1]  # the scheduler's exact old schedule
+        assert notes == [(0, "boom 1"), (1, "boom 2")]
+
+    def test_exhausted_attempts_reraise_last_error(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError(f"fail {len(calls)}")
+
+        with pytest.raises(ValueError, match="fail 3"):
+            retry_call(fn, BackoffPolicy(attempts=3), sleep=lambda s: None)
+        assert len(calls) == 3  # attempts are TOTAL tries
+
+    def test_retry_on_filters_and_base_exceptions_propagate(self):
+        # the PR 3 lesson, structurally: KeyboardInterrupt is not an
+        # Exception, so the default retry_on can never eat an abort
+        def interrupt():
+            raise KeyboardInterrupt()
+
+        with pytest.raises(KeyboardInterrupt):
+            retry_call(interrupt, BackoffPolicy(attempts=5), sleep=lambda s: None)
+
+        def typed():
+            raise ValueError("not retryable here")
+
+        with pytest.raises(ValueError):
+            retry_call(
+                typed, BackoffPolicy(attempts=5), retry_on=KeyError,
+                sleep=lambda s: None,
+            )
+
+    def test_on_retry_raise_aborts_unbounded_loop(self):
+        # the supervisor's shutdown hatch: an UNBOUNDED restart loop ends
+        # when on_retry raises (pool closed) instead of spinning forever
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError("still down")
+
+        def stop_after(a, e):
+            if a >= 2:
+                raise e
+
+        with pytest.raises(RuntimeError, match="still down"):
+            retry_call(
+                fn, BackoffPolicy(attempts=UNBOUNDED, base_s=0.0),
+                on_retry=stop_after, sleep=lambda s: None,
+            )
+        assert len(calls) == 3
+
+    def test_seeded_jitter_reaches_sleep(self):
+        slept_a, slept_b = [], []
+
+        def failing(n=[0]):
+            n[0] += 1
+            if n[0] % 4:
+                raise RuntimeError("x")
+            return "ok"
+
+        p = BackoffPolicy(attempts=4, base_s=0.1, jitter_s=0.2)
+        retry_call(failing, p, sleep=slept_a.append, rng=random.Random(5))
+        retry_call(failing, p, sleep=slept_b.append, rng=random.Random(5))
+        assert slept_a == slept_b
+        assert all(0.1 <= s for s in slept_a)
