@@ -466,14 +466,16 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
     batch_runs = []
     for rep in range(3):
         pos = pos0
-        nxt = toks[-1]
+        # the packed bundle's last TOKEN row (rows chunk/chunk+1 carry the
+        # integrity fingerprint + finiteness flags, engine/integrity.py)
+        nxt = toks[chunk - 1]
         with telemetry.trace_span("bench_batch_decode", rep=rep, b=B):
             sw = Stopwatch()
             for _ in range(n_rounds):
                 toks_r, slab, bkeys = decode_chunk_batched(
                     cfg, params, nxt, slab, pos, active, chunk, temps, topps, bkeys
                 )
-                nxt = toks_r[-1]
+                nxt = toks_r[chunk - 1]
                 pos = pos + chunk
             np.asarray(toks_r)
             batch_runs.append(B * n_rounds * chunk / sw.elapsed_s())

@@ -78,7 +78,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_llama_tpu import retry
-from distributed_llama_tpu.engine import faults
+from distributed_llama_tpu.engine import faults, integrity
 from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket, next_pow2
 from distributed_llama_tpu.engine.speculative import PromptLookupDrafter
 from distributed_llama_tpu.models import llama
@@ -213,6 +213,15 @@ class BatchStream:
         self._history: list[int] = []
         self._drafter: PromptLookupDrafter | None = None
         self._spec_on = False
+        # per-chunk device logit fingerprints (ISSUE 10), in delivery
+        # order (the fetch-ownership design delivers chunk N strictly
+        # before N+1), reset at _join so one request = one sequence. A
+        # RUNNING fold would be race-dependent — the pipelined chunk
+        # dispatched ahead of the stream's last consumed token may or may
+        # not deliver before the stream leaves — so readers fold a
+        # deterministic PREFIX via run_fingerprint(n_tokens). The
+        # spec-verify path does not feed it (stays empty)
+        self._chunk_fps: list[int] = []
         # a chunk failure retires ONLY this row (faults.RowQuarantined /
         # StallTimeout / DeadlineExceeded, set by the scheduler under its
         # lock); next_token raises it, surviving co-batched rows keep
@@ -256,6 +265,22 @@ class BatchStream:
         self._history = []
         self._drafter = None
         self._spec_on = False
+
+    def run_fingerprint(self, n_tokens: int | None = None) -> int:
+        """FNV-1a fold of this request's chunk fingerprints (ISSUE 10).
+        ``n_tokens`` folds only the chunks that produced the first
+        ``n_tokens`` DECODED tokens (the fused first token is sampled
+        pre-chunk and carries no fingerprint) — deterministic no matter
+        how many speculative chunks the pipeline delivered beyond them,
+        which is what lets the integrity canary compare the value against
+        a golden. ``None`` folds everything delivered so far."""
+        fps = self._chunk_fps
+        if n_tokens is not None:
+            fps = fps[: -(-max(0, n_tokens) // self.scheduler.chunk)]
+        out = integrity.FP_BASIS
+        for fp in fps:
+            out = integrity.fold_run_fingerprint(out, fp)
+        return out
 
     def rollback(self, pos: int) -> None:
         """Rewind to ``pos`` (prefix-cache reuse / early-stop contract).
@@ -621,6 +646,11 @@ class BatchScheduler:
         self.replica_id = int(replica_id)
         self.health_hook = None
         self.lost_on_stall = False
+        # armed by an engine.sdc kind=corrupt message=logits rule: each
+        # pending unit perturbs ONE fetched chunk's token columns in-vocab
+        # (finite, wrong, invisible to the vocab/finite validation — the
+        # class only the canary's golden comparison can see)
+        self._sdc_logits_pending = 0
         self._lost = False
         self.lost_cause: str | None = None
         self.lost_victims = 0
@@ -669,12 +699,17 @@ class BatchScheduler:
     # restarts this replica with jittered backoff (server/replicas.py).
     # ------------------------------------------------------------------
 
-    def mark_lost(self, cause: str) -> None:
-        """Declare this replica dead (pool/tests entry point). Idempotent."""
+    def mark_lost(self, cause: str, corrupt: bool = False) -> None:
+        """Declare this replica dead (pool/tests entry point). Idempotent.
+        ``corrupt=True`` marks an integrity-detected loss (canary/shadow
+        mismatch, ISSUE 10): victims get :class:`faults.ReplicaCorrupt`,
+        which the serving layer replays ONLY while nothing has streamed —
+        deltas already sent by a silently-corrupt replica may themselves
+        be wrong, and a suppressed replay would splice onto them."""
         with self._cond:
-            self._mark_lost_locked(cause)
+            self._mark_lost_locked(cause, corrupt=corrupt)
 
-    def _mark_lost_locked(self, cause: str) -> None:
+    def _mark_lost_locked(self, cause: str, corrupt: bool = False) -> None:
         """The one death path (cond held): every stream gets ReplicaLost
         (a mid-prefill request raises it at its next chunk boundary, a
         decoding one at its next ``next_token``), page pins release, the
@@ -687,8 +722,9 @@ class BatchScheduler:
         self._lost = True
         self.lost_cause = cause
         self.lost_victims = sum(1 for s in self._streams if s._joined)
+        err_cls = faults.ReplicaCorrupt if corrupt else faults.ReplicaLost
         for s in self._streams:
-            s._fetch_error = faults.ReplicaLost(
+            s._fetch_error = err_cls(
                 f"replica {self.replica_id} lost: {cause}"
             )
             self._release_pins_locked(s)
@@ -1144,6 +1180,7 @@ class BatchScheduler:
             stream._queue.clear()
             stream._epoch += 1
             stream._joined = True
+            stream._chunk_fps = []
             if not isinstance(
                 stream._fetch_error, (faults.RowPreempted, faults.ReplicaLost)
             ):
@@ -1358,6 +1395,11 @@ class BatchScheduler:
         except Exception as e:
             self._mark_lost_locked(f"injected crash at dispatch: {e}")
             return None
+        # silent-data-corruption site (ISSUE 10): kind=corrupt perturbs
+        # this replica's weights (or the next fetched chunk's tokens) into
+        # FINITE wrong values — nothing raises, nothing quarantines; only
+        # the integrity layer (canary golden / shadow vote) can see it
+        self._fire_sdc_locked()
         with engine._depth_lock:
             engine._pipeline_depth += 1  # released when the fetch drains
         result = None
@@ -1399,6 +1441,31 @@ class BatchScheduler:
             return None
         return result
 
+    def _fire_sdc_locked(self) -> None:
+        """The ``engine.sdc`` chaos site (ISSUE 10), fired per batched
+        dispatch with ``row=`` selecting the REPLICA id. A ``kind=corrupt``
+        rule injects the silent-data-corruption class every other site
+        cannot model: ``message=weights`` (the default) deterministically
+        perturbs one weight slice of this replica's engine IN PLACE
+        (every later decode emits plausible wrong tokens until the canary
+        kills the replica and the supervisor rebuilds + checksum-verifies
+        it); ``message=logits`` arms a one-chunk in-vocab token
+        perturbation applied at the next fetch delivery."""
+        rule = self._faults.fires("engine.sdc", row=self.replica_id)
+        if rule is None or rule.kind != "corrupt":
+            return
+        if (rule.message or "weights") == "logits":
+            self._sdc_logits_pending += 1
+            return
+        engine = self.engine
+        engine.params, desc = integrity.corrupt_params(
+            engine.params, seed=getattr(self._faults, "seed", 0)
+        )
+        print(
+            f"🧬 engine.sdc injected on replica {self.replica_id}: "
+            f"corrupted {desc}"
+        )
+
     def _dispatch_locked(self) -> None:
         """Build and dispatch one batched chunk from the joined streams
         (cond lock held; the dispatch itself is asynchronous). Rows inside
@@ -1437,7 +1504,7 @@ class BatchScheduler:
 
                 if engine._tp_engine is None:
                     if self._pool is not None:
-                        tokens, self._slab, new_keys = (
+                        out, self._slab, new_keys = (
                             sampling.decode_chunk_batched_paged(
                                 engine.cfg, engine.params, first, self._slab,
                                 pos, active, self._pool, self.chunk, temps,
@@ -1445,12 +1512,12 @@ class BatchScheduler:
                             )
                         )
                     else:
-                        tokens, self._slab, new_keys = sampling.decode_chunk_batched(
+                        out, self._slab, new_keys = sampling.decode_chunk_batched(
                             engine.cfg, engine.params, first, self._slab, pos,
                             active, self.chunk, temps, topps, keys,
                         )
                 elif self._pool is not None:
-                    tokens, self._slab, new_keys = (
+                    out, self._slab, new_keys = (
                         engine._tp_engine.batched_decode_chunk_paged(
                             engine.params, first, self._slab, self._pool, pos,
                             active, self.chunk, temps, topps, keys, tables,
@@ -1458,13 +1525,13 @@ class BatchScheduler:
                         )
                     )
                 else:
-                    tokens, self._slab, new_keys = (
+                    out, self._slab, new_keys = (
                         engine._tp_engine.batched_decode_chunk(
                             engine.params, first, self._slab, pos, active,
                             self.chunk, temps, topps, keys,
                         )
                     )
-            return tokens, new_keys
+            return out, new_keys
 
         result = self._run_dispatch_locked(
             joined, dispatch,
@@ -1473,17 +1540,19 @@ class BatchScheduler:
         )
         if result is None:
             return
-        tokens, new_keys = result
+        # the packed [chunk + 2, B] bundle: token rows 0..chunk-1 plus the
+        # per-row fingerprint/finite rows (engine/integrity.py)
+        out, new_keys = result
         for s in joined:
             # the next chunk seeds from this chunk's last token and advanced
             # key — both stay device-resident (no fetch on the critical path)
-            s._first = tokens[-1, s.row]
+            s._first = out[self.chunk - 1, s.row]
             s._key = new_keys[s.row]
             s.pos += self.chunk
         if engine._tel.enabled:
             engine._tel.batch_occupancy.set(len(joined) / bucket)
         self._pending = (
-            "chunk", tokens, [(s, s._epoch) for s in joined], bucket,
+            "chunk", out, [(s, s._epoch) for s in joined], bucket,
             len(joined), sw, None,
         )
 
@@ -1674,7 +1743,22 @@ class BatchScheduler:
         entry = engine._split_stats(per_token_ms)
         tel = engine._tel
         bad_rows: set[int] = set()
+        nonfinite_rows: set[int] = set()
+        fps = None
         if toks is not None:
+            # unpack the [chunk + 2, B] bundle: tokens + per-row logit
+            # fingerprint + finiteness flag (ONE fetch moved all three)
+            toks, fps, finite = integrity.split_chunk_outputs(toks, self.chunk)
+            with self._cond:
+                if self._sdc_logits_pending > 0:
+                    # engine.sdc message=logits: shift every token column
+                    # in-vocab — finite, wrong, and INVISIBLE to the
+                    # validation below; only a canary/shadow token
+                    # comparison can see it (the fingerprint keeps its
+                    # honest pre-corruption value on purpose: the logits
+                    # themselves were clean)
+                    self._sdc_logits_pending -= 1
+                    toks = (toks + 1) % engine.cfg.vocab_size
             rule = self._faults.fires(
                 "batch.row", rows=[s.row for s, _ in snapshot]
             )
@@ -1687,6 +1771,13 @@ class BatchScheduler:
                 toks[:, rule.row] = -1  # rejected by the validation below
             vocab = engine.cfg.vocab_size
             for s, _ in snapshot:
+                # the device-side finiteness flag closes the sampled-path
+                # hole (ISSUE 10 satellite): NaN logits pushed through the
+                # softmax sampler can yield a perfectly in-vocab id the
+                # vocab check below would wave through
+                if not finite[s.row]:
+                    nonfinite_rows.add(s.row)
+                    continue
                 col = toks[:, s.row]
                 if not ((col >= 0) & (col < vocab)).all():
                     bad_rows.add(s.row)
@@ -1699,26 +1790,35 @@ class BatchScheduler:
             for s, epoch in snapshot:
                 if not (s._joined and s._epoch == epoch):
                     continue
-                if toks is None or s.row in bad_rows:
+                if toks is None or s.row in bad_rows or s.row in nonfinite_rows:
                     # the row's tokens are lost/corrupt and its position
                     # already advanced at dispatch: retire THIS row with
                     # a typed error instead of emitting a silent token
                     # hole — and instead of the seed's poison-everyone
-                    err = faults.RowQuarantined(
-                        "batch row retired: chunk "
-                        + (
-                            f"fetch failed after {self.retries + 1} attempts"
-                            if toks is None
-                            else "produced corrupt tokens (NaN-logits "
-                            "class failure)"
+                    if s.row in nonfinite_rows:
+                        err: faults.RowQuarantined = faults.NonFiniteLogits(
+                            "batch row retired: decode produced non-finite "
+                            "logits for this row (caught by the device-side "
+                            "finiteness flag before a sampled token could "
+                            "launder it in-vocab)"
                         )
-                    )
+                    else:
+                        err = faults.RowQuarantined(
+                            "batch row retired: chunk "
+                            + (
+                                f"fetch failed after {self.retries + 1} attempts"
+                                if toks is None
+                                else "produced corrupt tokens (NaN-logits "
+                                "class failure)"
+                            )
+                        )
                     err.__cause__ = error
                     s._fetch_error = err
                     self._release_pins_locked(s)
                     tel.rows_quarantined.inc()
                     continue
                 s._queue.extend(int(t) for t in toks[:, s.row])
+                s._chunk_fps.append(int(fps[s.row]))
                 s.stats.extend([entry] * self.chunk)
                 delivered += 1
                 if tel.enabled:

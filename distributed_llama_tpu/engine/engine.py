@@ -883,6 +883,13 @@ class InferenceEngine:
             self._tp_engine, "accepts_n_real", False
         )
         self._streams: list[EngineStream] = []
+        # load-time weight checksum (ISSUE 10): computed lazily on first
+        # read and cached — the replica pool records replica 0's value as
+        # the pool reference at construction and verifies every rebuilt
+        # replica against it before re-entering placement. Lazy, so
+        # engines that never join a supervised pool pay nothing; ONE HBM
+        # pass over the weights when they do (engine/integrity.py)
+        self._weights_checksum: str | None = None
         # the classic single-stream surface's stream is created LAZILY on
         # first use: batched serving (engine.batch) never touches it, and
         # eagerly allocating its KV cache would hold one full cache of HBM
@@ -899,6 +906,19 @@ class InferenceEngine:
         # >0 would freeze the transfer estimate, a negative one would let
         # probes run mid-flight)
         self._depth_lock = threading.Lock()
+
+    def weights_checksum(self) -> str:
+        """The loaded weights' integrity checksum (cached after the first
+        computation — call it right after construction to RECORD the
+        healthy value before any runtime corruption could land; a later
+        :func:`integrity.params_checksum` over ``self.params`` is the
+        VERIFY side). The cached value deliberately does NOT track
+        ``self.params`` reassignment: it is the load-time record."""
+        if self._weights_checksum is None:
+            from distributed_llama_tpu.engine import integrity
+
+            self._weights_checksum = integrity.params_checksum(self.params)
+        return self._weights_checksum
 
     def _new_cache(self):
         if self._tp_engine is not None:

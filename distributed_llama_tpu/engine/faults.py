@@ -35,6 +35,18 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     a ``row=`` rule quarantines ONLY the targeted row AND
                     releases its page pins (the aliased pages stay live
                     for every other row; survivors bit-identical)
+``engine.sdc``      silent-data-corruption injection (ISSUE 10): a
+                    ``kind=corrupt`` rule fired per batched-chunk dispatch
+                    deterministically perturbs this replica's state into
+                    FINITE-but-wrong values — ``message=weights`` (the
+                    default) flips a weight slice in place so every
+                    subsequent decode emits plausible wrong tokens,
+                    ``message=logits`` perturbs the next fetched chunk's
+                    token columns in-vocab. Neither NaNs nor raises: the
+                    class the quarantine path cannot see, detectable only
+                    by integrity checks (engine/integrity.py canaries /
+                    fingerprints / shadow votes). ``row=`` selects the
+                    REPLICA id, like the replica.* sites
 ``engine.preempt``  raise during a priority preemption's eviction
                     (engine/batch.py ``preempt_below``): the victim row is
                     QUARANTINED instead of cleanly requeued — its request
@@ -135,6 +147,14 @@ class RowPreempted(RuntimeError):
     (already-sent SSE deltas are suppressed on replay)."""
 
 
+class NonFiniteLogits(RowQuarantined):
+    """A decode step produced NaN/Inf logits for this row (ISSUE 10): the
+    device-side finiteness flag fetched with every batched chunk — or the
+    host sampler's pre-sampling validation — caught it BEFORE a sampled
+    token could launder the corruption into a plausible in-vocab id. The
+    row is quarantined exactly like any corrupt chunk."""
+
+
 class ReplicaLost(RuntimeError):
     """This request's WHOLE replica (engine + BatchScheduler) died — a
     crashed dispatch, or a hang the stall watchdog escalated (ISSUE 9).
@@ -146,7 +166,19 @@ class ReplicaLost(RuntimeError):
     (server/replicas.py; docs/ROBUSTNESS.md failure-domain table)."""
 
 
-KINDS = ("raise", "nan", "delay", "hang", "disconnect")
+class ReplicaCorrupt(ReplicaLost):
+    """This request's replica was declared dead for SILENT DATA CORRUPTION
+    (a canary/shadow integrity mismatch, ISSUE 10) — wrong-but-finite
+    outputs, not a crash. Crucially different from a plain
+    :class:`ReplicaLost` for a stream that already sent deltas: those
+    deltas may themselves be corrupt, so a suppressed replay would SPLICE
+    a wrong prefix onto a correct continuation. The serving layer replays
+    a ReplicaCorrupt victim only while nothing has streamed; otherwise the
+    stream ends with a typed ``replica_corrupt`` error — loud failure
+    instead of laundered corruption (server/api.py ``complete``)."""
+
+
+KINDS = ("raise", "nan", "delay", "hang", "disconnect", "corrupt")
 
 # The registered injection sites — the single source of truth the static
 # analyzer's FLT-001 rule cross-checks against every fire()/fires() call
@@ -163,6 +195,7 @@ SITES = (
     "engine.spec_verify",
     "engine.paged_attn",
     "engine.preempt",
+    "engine.sdc",
     "replica.crash",
     "replica.hang",
     "replica.slow",

@@ -127,6 +127,24 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 1) — proves a chaos fault actually fired, e.g. "
         "'dllama_replica_failovers_total:1'; repeatable",
     )
+    p.add_argument(
+        "--expect-zero", action="append", default=[], metavar="NAME",
+        help="assert a server counter's run delta did NOT move — the "
+        "mirror of --expect-delta (ISSUE 10): a clean run gating "
+        "'dllama_sdc_mismatches_total' to zero proves the integrity "
+        "layer raises no false positives; repeatable",
+    )
+    p.add_argument(
+        "--canary-interval-s", type=float, default=0.0,
+        help="self-host SDC canary period (--sdc-canary-interval-s on "
+        "the server): pinned greedy probes per replica compared against "
+        "the pool golden; 0 disables",
+    )
+    p.add_argument(
+        "--shadow-rate", type=float, default=0.0,
+        help="self-host cross-replica shadow-vote sampling fraction "
+        "(--sdc-shadow-rate on the server)",
+    )
     return p
 
 
@@ -172,6 +190,8 @@ def main(argv=None) -> int:
             faults_seed=args.faults_seed,
             admission_queue=args.admission_queue,
             replicas=args.replicas,
+            canary_interval_s=args.canary_interval_s,
+            shadow_rate=args.shadow_rate,
         )
         url = host.url
         print(f"self-hosted server at {url}", file=sys.stderr)
@@ -224,17 +244,41 @@ def main(argv=None) -> int:
             report["checks"]["expected_deltas"] = rep.check_expected_deltas(
                 report, args.expect_delta
             )
+        if args.expect_zero:
+            report["checks"]["expected_zero"] = rep.check_expected_zero(
+                report, args.expect_zero
+            )
         text = rep.dump_report(report, args.out)
         print(text)
         if not replay_ok:
             print("FATAL: schedule replay fingerprint mismatch", file=sys.stderr)
             return 2
+        # explicitly requested gates (--goodput-floor/--expect-delta/
+        # --expect-zero) are ALWAYS enforced: asking for a gate and then
+        # ignoring its verdict tests nothing. --assert additionally
+        # enforces the built-in consistency/fairness checks — an SDC
+        # chaos run skips it on purpose: requests a corrupt replica
+        # served before detection stream wrong-but-completed bodies,
+        # which is exactly the failure mode under test, not a harness bug
+        requested = [
+            report["checks"].get(k)
+            for k in ("goodput", "expected_deltas", "expected_zero")
+        ]
+        bad = [
+            f"[{k}] {v}"
+            for k, chk in zip(
+                ("goodput", "expected_deltas", "expected_zero"), requested
+            )
+            if chk and not chk.get("ok", True)
+            for v in chk.get("violations", [])
+        ]
         if args.assert_checks or args.isolation:
             bad = rep.failed_checks(report)
-            if bad:
-                for v in bad:
-                    print(f"CHECK FAILED: {v}", file=sys.stderr)
-                return 1
+        if bad:
+            for v in bad:
+                print(f"CHECK FAILED: {v}", file=sys.stderr)
+            return 1
+        if args.assert_checks or args.isolation or any(requested):
             print("all checks passed", file=sys.stderr)
         return 0
     finally:

@@ -57,6 +57,11 @@ SERVER_COUNTERS = (
     "dllama_replica_failovers_total",
     "dllama_replica_restarts_total",
     "dllama_replayed_requests_total",
+    # silent-data-corruption detection (ISSUE 10): the SDC chaos smoke
+    # gates --expect-delta on mismatches/failovers and --expect-zero on
+    # the clean run's mismatch counter (zero false positives)
+    "dllama_sdc_checks_total",
+    "dllama_sdc_mismatches_total",
 )
 
 
@@ -349,6 +354,35 @@ def check_expected_deltas(report: dict, specs: list[str]) -> dict:
                 f"counter {name!r} moved {got:g}, expected >= {floor:g}"
             )
     return {"ok": not violations, "expected": expected,
+            "violations": violations}
+
+
+def check_expected_zero(report: dict, names: list[str]) -> dict:
+    """Gate on server-side counter STILLNESS: each ``name``'s run delta
+    must be exactly 0. The mirror image of :func:`check_expected_deltas`
+    (ISSUE 10): a clean run proving `dllama_sdc_mismatches_total` did NOT
+    move is the zero-false-positive witness — an integrity layer that
+    cries wolf on healthy replicas would fail over the whole pool for
+    nothing. An absent series reads as 0 (telemetry may be off)."""
+    violations: list[str] = []
+    server = report.get("server")
+    if server is None:
+        # a failed /metrics scrape would make every stillness claim
+        # vacuously true — that is not a passing gate
+        return {"ok": False, "expected_zero": list(names),
+                "violations": ["no server metric deltas in the report"]}
+    checked: list[str] = []
+    for name in names:
+        name = name.strip()
+        if not name:
+            continue
+        checked.append(name)
+        got = server.get(name, 0.0) or 0.0
+        if got != 0:
+            violations.append(
+                f"counter {name!r} moved {got:g}, expected exactly 0"
+            )
+    return {"ok": not violations, "expected_zero": checked,
             "violations": violations}
 
 

@@ -61,6 +61,8 @@ def start_selfhost(
     deadline_ms: float | None = None,
     seed: int = 0,
     replicas: int = 1,
+    canary_interval_s: float = 0.0,
+    shadow_rate: float = 0.0,
 ) -> SelfHost:
     """Build the tiny synthetic model + tokenizer, construct the real
     ApiState (batched decode, prefix cache, weighted-fair admission) and
@@ -108,6 +110,12 @@ def start_selfhost(
         # keeps the dead-replica-returns window inside a CI smoke
         replicas=replicas,
         replica_restart_backoff_s=0.1,
+        # SDC integrity chaos (ISSUE 10): a fast canary cadence keeps the
+        # detect→failover→checksum-verified-restart story inside a CI
+        # smoke window; short probes keep them cheap next to real traffic
+        sdc_canary_interval_s=canary_interval_s,
+        sdc_canary_tokens=8,
+        sdc_shadow_rate=shadow_rate,
     )
     # each replica loads the same weights (compiled programs are shared
     # across engines — same shapes, same static config)

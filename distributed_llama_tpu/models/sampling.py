@@ -20,6 +20,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from distributed_llama_tpu.engine import integrity
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 
@@ -190,6 +191,7 @@ def batched_decode_scan(
     topp: jax.Array,  # [B]
     axis_name: str | None = None,
     paged=None,  # (pool, tables, matched) — zero-copy prefix aliasing
+    fingerprint: bool = True,
 ):
     """The batched decode body: B sequences step together, each weight
     matrix read once per step. Per row it is the same forward → split →
@@ -198,13 +200,21 @@ def batched_decode_scan(
     single-stream chunked decode for the same per-row key. Inactive rows
     compute garbage (masked out of cache writes and position advances) so
     requests can join/leave between chunks without a recompile. Returns
-    (tokens [n_steps, B], cache, advanced keys [B, 2]). ``paged``: each
-    row's matched prompt prefix is read from the shared page pool through
-    its page table instead of the slab (the pool rides the scan as a
-    read-only closure capture — no copy, no donation)."""
+    (tokens [n_steps, B], cache, advanced keys [B, 2], fingerprints
+    uint32 [B], finite bool [B]). ``paged``: each row's matched prompt
+    prefix is read from the shared page pool through its page table
+    instead of the slab (the pool rides the scan as a read-only closure
+    capture — no copy, no donation).
+
+    ``fingerprint`` folds each step's per-row logit sum + token into an
+    FNV-1a hash and a finiteness flag ON DEVICE (engine/integrity.py —
+    the SDC detection substrate, ISSUE 10); the sampling itself is
+    untouched, so the token stream is bit-identical either way.
+    ``fingerprint=False`` skips the fold (same outputs, initial-state
+    hashes) — the overhead-bound test compiles both and compares."""
 
     def step(carry, _):
-        tokens, cache_c, p, ks = carry
+        tokens, cache_c, p, ks, h, okf = carry
         logits, cache_c = llama.forward_step_batched(
             cfg, params, tokens, cache_c, p, active, axis_name=axis_name,
             paged=paged,
@@ -214,16 +224,22 @@ def batched_decode_scan(
         split = jax.vmap(jax.random.split)(ks)  # [B, 2, 2]
         ks2, subs = split[:, 0], split[:, 1]
         nxt = sample_tokens_batched(logits, subs, temperature, topp)
+        if fingerprint:
+            h, okf = integrity.fingerprint_fold(h, okf, logits, nxt)
         p2 = jnp.where(active, p + 1, p)
-        return (nxt.astype(jnp.int32), cache_c, p2, ks2), nxt
+        return (nxt.astype(jnp.int32), cache_c, p2, ks2, h, okf), nxt
 
-    (_, cache, _, keys), tokens = jax.lax.scan(
+    h0, ok0 = integrity.fingerprint_init(first_tokens.shape[0])
+    (_, cache, _, keys, h, okf), tokens = jax.lax.scan(
         step,
-        (first_tokens.astype(jnp.int32), cache, pos.astype(jnp.int32), keys),
+        (
+            first_tokens.astype(jnp.int32), cache, pos.astype(jnp.int32),
+            keys, h0, ok0,
+        ),
         None,
         length=n_steps,
     )
-    return tokens, cache, keys
+    return tokens, cache, keys, h, okf
 
 
 @functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(3,))
@@ -244,11 +260,17 @@ def decode_chunk_batched(
     positions, sampler settings and PRNG keys — one compiled program per
     (bucket, chunk) shape serves every mix of requests. The slab cache is
     donated and aliases in place; advanced per-row keys return so each
-    stream continues exactly as its single-stream chunked decode would."""
-    return batched_decode_scan(
+    stream continues exactly as its single-stream chunked decode would.
+
+    Returns ``(out, cache, keys)`` where ``out`` is the packed
+    [n_steps + 2, B] int32 bundle of tokens + per-row logit fingerprint +
+    finiteness flag (engine/integrity.py ``split_chunk_outputs``) — one
+    fetch still moves everything the scheduler needs."""
+    tokens, cache, keys, h, okf = batched_decode_scan(
         cfg, params, first_tokens, cache, pos, active, keys, n_steps,
         temperature, topp,
     )
+    return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
 
 
 @functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(3,))
@@ -271,11 +293,13 @@ def decode_chunk_batched_paged(
     whose prompt hit the radix cache read their matched prefix straight out
     of the shared page pool every step — no gathered slab duplicate exists.
     Only the slab is donated; the pool is shared across every row and
-    dispatch, so it must never alias."""
-    return batched_decode_scan(
+    dispatch, so it must never alias. Same packed [n_steps + 2, B] return
+    bundle as :func:`decode_chunk_batched`."""
+    tokens, cache, keys, h, okf = batched_decode_scan(
         cfg, params, first_tokens, cache, pos, active, keys, n_steps,
         temperature, topp, paged=(pool, tables, matched),
     )
+    return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
 
 
 # ---------------------------------------------------------------------------

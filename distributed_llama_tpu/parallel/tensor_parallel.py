@@ -633,10 +633,15 @@ class TensorParallelForward(TransferProbeMixin):
         batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
 
         def fn(params, first_tokens, cache, pos, active, temperature, topp, keys):
-            return sampling.batched_decode_scan(
+            from distributed_llama_tpu.engine import integrity
+
+            tokens, cache, keys, h, okf = sampling.batched_decode_scan(
                 cfg, params, first_tokens, cache, pos, active, keys, n_steps,
                 temperature, topp, axis_name="tp",
             )
+            # the fingerprint folds the all-gathered full-vocab logits, so
+            # every shard packs the same replicated bundle (integrity.py)
+            return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
 
         mapped = shard_map(
             fn,
@@ -796,11 +801,14 @@ class TensorParallelForward(TransferProbeMixin):
 
         def fn(params, first_tokens, cache, pool, pos, active, temperature,
                topp, keys, tables, matched):
-            return sampling.batched_decode_scan(
+            from distributed_llama_tpu.engine import integrity
+
+            tokens, cache, keys, h, okf = sampling.batched_decode_scan(
                 cfg, params, first_tokens, cache, pos, active, keys, n_steps,
                 temperature, topp, axis_name="tp",
                 paged=(pool, tables, matched),
             )
+            return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
 
         mapped = shard_map(
             fn,

@@ -56,7 +56,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_llama_tpu import retry, telemetry
-from distributed_llama_tpu.engine import faults
+from distributed_llama_tpu.engine import faults, integrity
 from distributed_llama_tpu.engine.faults import DeadlineExceeded
 from distributed_llama_tpu.server.admission import (
     DEFAULT_TENANT,
@@ -112,6 +112,17 @@ MAX_PREEMPT_REQUEUES = 3
 # satellite): N+1 total attempts, no sleep between them — the fair
 # admission queue IS the backpressure
 REQUEUE_POLICY = retry.BackoffPolicy(attempts=MAX_PREEMPT_REQUEUES + 1)
+
+# the SDC canary's pinned probe prompt (ISSUE 10): any fixed string works —
+# what matters is that the SAME prompt decodes greedily through the real
+# batched path on every replica, so (tokens, fingerprint) has exactly one
+# healthy value per weights+config (the pool golden, server/replicas.py)
+CANARY_PROMPT = "integrity canary: count one two three four five"
+
+# the canary row's priority sits below every real class, so a queued
+# request preempts the probe instead of waiting behind it (the probe then
+# reports "inconclusive" and retries next cycle)
+CANARY_PRIORITY = -(1 << 30)
 
 
 @dataclasses.dataclass
@@ -292,6 +303,35 @@ class ApiState:
         )
         self._retry_rng = random.Random()
         self.draining = False
+        # silent-data-corruption detection (ISSUE 10, engine/integrity.py):
+        # the pool's canary scheduler runs _canary_probe — a pinned greedy
+        # prompt through each replica's REAL batched path on a directly
+        # claimed lane, billed to the reserved internal tenant (no
+        # admission permit, no fairness accounting) — and compares
+        # (tokens, fingerprint) against the pool golden. 0 disables the
+        # background thread; the probe stays armed for manual ticks and
+        # the shadow-vote path either way.
+        self.canary_prompt = (
+            getattr(args, "sdc_canary_prompt", None) or CANARY_PROMPT
+        )
+        self.canary_tokens = int(getattr(args, "sdc_canary_tokens", 12) or 12)
+        self.shadow_rate = float(getattr(args, "sdc_shadow_rate", 0.0) or 0.0)
+        # entropy-seeded: this RNG only picks WHICH greedy requests get a
+        # shadow re-execution — determinism here would shadow the same
+        # schedule positions on every restored replica set
+        self._shadow_rng = random.Random()
+        # at most ONE shadow vote in flight: each vote serially re-decodes
+        # on two replicas, and an unbounded thread-per-sample design would
+        # let a hot request rate stack probes until they starve real
+        # traffic of lanes; extra samples are simply dropped (it is a
+        # sampling check — coverage comes from rate x uptime, not backlog)
+        self._shadow_gate = threading.Semaphore(1)
+        interval = getattr(args, "sdc_canary_interval_s", None)
+        self.pool.start_canary(
+            self._canary_probe,
+            0.0 if interval is None else float(interval),
+            fail_threshold=int(getattr(args, "sdc_canary_threshold", 2) or 2),
+        )
         # bind-once fault-injection plan (engine/faults.py): the SSE writer
         # fires the server.send site through it (kind=disconnect models a
         # client vanishing mid-stream)
@@ -404,6 +444,106 @@ class ApiState:
             "free_slots": max(0, self.admission.free_slots()),
             "replicas": self.pool.snapshot(),
         }
+
+    def _canary_probe(self, rep, messages=None):
+        """Execute one integrity probe on replica ``rep`` (ISSUE 10): a
+        pinned greedy prompt (or ``messages`` — the shadow-vote path)
+        through the replica's real batched decode on a directly claimed
+        lane, prefix cache opted out (the probe must exercise THIS
+        replica's weights, not shared pool pages) and priority below every
+        real class (queued work preempts it). Returns the
+        ``(tokens, fingerprint)`` pair the pool compares against its
+        golden, or None when inconclusive — every lane busy, the probe
+        preempted, or the replica lost mid-probe."""
+        slot = self.pool.claim_slot(rep.idx, tenant=integrity.CANARY_TENANT)
+        if slot is None:
+            return None
+        stream = slot.stream
+        try:
+            # the probe owns the lane for its duration: clear any previous
+            # conversation's KV + chat cache (self-healing anyway, but the
+            # stream position and the cache must agree)
+            stream.reset()
+            slot.cache.clear()
+            stream.prefix_cache_enabled = False
+            stream.tenant = integrity.CANARY_TENANT
+            stream.priority = CANARY_PRIORITY
+            msgs = messages or [
+                {"role": "user", "content": self.canary_prompt}
+            ]
+            items = [ChatItem(m["role"], m["content"]) for m in msgs]
+            prompt = self.template.generate(items, append_generation_prompt=True)
+            toks = self.tokenizer.encode(prompt, add_bos=True)
+            budget = stream.cfg.seq_len - len(toks) - 1
+            n = max(1, min(self.canary_tokens, budget))
+            if budget < 1:
+                return None  # probe prompt does not fit this config
+            first_dev, key = stream.prefill_device(toks, 0.0, self.args.topp, 0)
+            out: list[int] = []
+
+            def on_token(prev: int, t: int) -> bool:
+                out.append(int(t))
+                return len(out) < n
+
+            stream.stream_decode(
+                first_dev, on_token, 0.0, self.args.topp, seed=0, key=key,
+                first_prev=toks[-1], limit=len(toks) + n,
+            )
+            if not out:
+                return None
+            # BatchStream carries the device logit fingerprints; fold the
+            # deterministic prefix covering exactly the decoded tokens
+            # (len(out) - 1: the fused first token precedes the chunks).
+            # An independent EngineStream (no batched path) compares
+            # tokens only — fingerprint None on both sides of the golden
+            fp = (
+                stream.run_fingerprint(len(out) - 1)
+                if hasattr(stream, "run_fingerprint") else None
+            )
+            return tuple(out), fp
+        except (faults.RowPreempted, faults.ReplicaLost, DeadlineExceeded):
+            return None  # yielded to real work / the replica died mid-probe
+        except faults.RowQuarantined:
+            # a LOUD failure (non-finite logits, corrupt chunk): the
+            # quarantine machinery already owns it; the canary's verdict
+            # on silent corruption is simply inconclusive this cycle
+            return None
+        finally:
+            try:
+                stream.reset()
+            except Exception:
+                pass
+            slot.cache.clear()
+            stream.prefix_cache_enabled = True
+            stream.tenant = None
+            stream.priority = None
+            self.pool.release(slot)
+
+    def _maybe_shadow(self, params: dict) -> None:
+        """Cross-replica shadow voting (ISSUE 10, ``--sdc-shadow-rate``):
+        a sampled fraction of completed GREEDY requests re-executes on two
+        live replicas off the request path (a daemon thread — the client's
+        latency never pays for the vote); divergence marks both suspect
+        and the canary resolves which one is corrupt."""
+        if (
+            self.shadow_rate <= 0.0
+            or params["temperature"] != 0.0
+            or len(self.pool.replicas) < 2
+            or self._shadow_rng.random() >= self.shadow_rate
+        ):
+            return
+        if not self._shadow_gate.acquire(blocking=False):
+            return  # a vote is already in flight: drop this sample
+
+        def vote():
+            try:
+                self.pool.shadow_vote(self._canary_probe, params["messages"])
+            finally:
+                self._shadow_gate.release()
+
+        threading.Thread(
+            target=vote, name="dllama-sdc-shadow", daemon=True
+        ).start()
 
     def _acquire_slot(
         self, messages: list[dict], deadline: float | None = None,
@@ -553,6 +693,18 @@ class ApiState:
                 self._release_slot(slot)
 
         def on_requeue(attempt: int, e: Exception) -> None:
+            if isinstance(e, faults.ReplicaCorrupt) and sent > 0:
+                # the replica died of SILENT CORRUPTION and this stream
+                # already delivered deltas — which may themselves be
+                # wrong. A suppressed replay assumes the sent prefix was
+                # correct (the bit-parity contract) and would SPLICE a
+                # corrupt prefix onto a healthy continuation; failing
+                # loudly (typed `replica_corrupt`, the client restarts
+                # from scratch) is the only honest exit. Raising here
+                # aborts the requeue loop (retry.retry_call's on_retry
+                # hatch). A victim with nothing streamed replays like any
+                # replica loss — nothing corrupt ever reached the client.
+                raise e
             if isinstance(e, NoPlaceableReplica):
                 # a placement bounce: nothing ran, so nothing replays —
                 # counting it would inflate replayed_requests exactly when
@@ -570,11 +722,15 @@ class ApiState:
             else:
                 self.tel.preempt_requeues.inc()
 
-        return retry.retry_call(
+        result = retry.retry_call(
             attempt_once, REQUEUE_POLICY,
             retry_on=(faults.RowPreempted, faults.ReplicaLost),
             on_retry=on_requeue,
         )
+        # shadow voting samples completed greedy requests (ISSUE 10):
+        # off-path, after the client already has its stream/result
+        self._maybe_shadow(params)
+        return result
 
     def _complete_on(
         self, slot: StreamSlot, params: dict, send_chunk, request_id: str,
@@ -829,9 +985,16 @@ class ApiState:
         # names feed the weighted-fair admission queues; priority defaults
         # to the tenant's configured class when the body omits it
         tenant = body.get("tenant", DEFAULT_TENANT)
-        if not isinstance(tenant, str) or not tenant or len(tenant) > 64:
+        if (
+            not isinstance(tenant, str) or not tenant or len(tenant) > 64
+            or tenant.startswith("_")
+        ):
+            # leading underscore is reserved for internal tenants (the SDC
+            # canary bills to integrity.CANARY_TENANT): a client must not
+            # be able to impersonate the probe's accounting bucket
             raise BadRequest(
-                "'tenant' must be a non-empty string of at most 64 chars"
+                "'tenant' must be a non-empty string of at most 64 chars "
+                "not starting with '_' (reserved)"
             )
         if deadline_ms is not None and not (
             math.isfinite(deadline_ms) and deadline_ms > 0
@@ -1101,10 +1264,15 @@ def make_handler(state: ApiState):
                 # replicas) MAX_PREEMPT_REQUEUES times in a row — shed it
                 # like overload rather than spinning a handler thread
                 # forever. Retry-After is jittered as usual.
-                kind = (
-                    "replica_lost"
-                    if isinstance(e, faults.ReplicaLost) else "preempted"
-                )
+                if isinstance(e, faults.ReplicaCorrupt):
+                    # integrity-detected loss mid-stream: already-sent
+                    # deltas are untrustworthy, so there was no replay —
+                    # the typed kind tells the client to restart fresh
+                    kind = "replica_corrupt"
+                elif isinstance(e, faults.ReplicaLost):
+                    kind = "replica_lost"
+                else:
+                    kind = "preempted"
                 if sse_started:
                     _sse_terminal_error(str(e), kind)
                 else:
@@ -1250,6 +1418,35 @@ def main(argv=None) -> None:
         help="base restart backoff for a dead replica (exponential to "
         "30s, entropy-jittered so restored replicas never restart in "
         "lockstep)",
+    )
+    # silent-data-corruption detection (ISSUE 10, docs/ROBUSTNESS.md
+    # "silent corruption" failure-domain row)
+    parser.add_argument(
+        "--sdc-canary-interval-s", type=float, default=0.0,
+        help="period of the per-replica SDC canary: a pinned greedy "
+        "prompt through each replica's real batched path on a reserved "
+        "internal lane, compared (tokens + logit fingerprint) against "
+        "the pool golden; consecutive mismatches walk the replica "
+        "healthy→suspect→dead and its supervisor rebuild must pass "
+        "weight-checksum verification. 0 disables the background canary",
+    )
+    parser.add_argument(
+        "--sdc-canary-tokens", type=int, default=12,
+        help="greedy tokens per canary probe (longer = more sensitive to "
+        "deep-layer corruption, costlier per probe)",
+    )
+    parser.add_argument(
+        "--sdc-canary-threshold", type=int, default=2,
+        help="consecutive canary mismatches before the replica is "
+        "declared corrupt-dead (1 = first mismatch kills; the default 2 "
+        "walks suspect first)",
+    )
+    parser.add_argument(
+        "--sdc-shadow-rate", type=float, default=0.0,
+        help="fraction of completed greedy requests re-executed on two "
+        "live replicas off-path and compared (cross-replica shadow "
+        "voting): divergence marks both suspect and the canary resolves "
+        "which is corrupt. 0 disables",
     )
     parser.add_argument(
         "--batch-decode", action=argparse.BooleanOptionalAction, default=True,

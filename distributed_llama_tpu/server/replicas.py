@@ -51,7 +51,8 @@ import threading
 import time
 
 from distributed_llama_tpu import retry
-from distributed_llama_tpu.engine import faults
+from distributed_llama_tpu.engine import faults, integrity
+from distributed_llama_tpu.telemetry import Stopwatch
 
 
 class NoPlaceableReplica(faults.ReplicaLost):
@@ -75,11 +76,14 @@ class Replica:
     """One failure domain: an engine + (optionally) its BatchScheduler and
     the serving slots riding on it. ``generation`` increments per rebuild
     so health events from a replaced scheduler can never touch its
-    successor."""
+    successor. ``integrity``/``last_canary``/``canary_fails`` are the SDC
+    canary's per-replica record (ISSUE 10): the /readyz snapshot reports
+    the first two, and consecutive canary mismatches walk the replica
+    down the health ladder."""
 
     __slots__ = (
         "idx", "engine", "scheduler", "slots", "state", "generation",
-        "restarts",
+        "restarts", "integrity", "last_canary", "canary_fails",
     )
 
     def __init__(self, idx: int, engine, scheduler, slots):
@@ -90,6 +94,9 @@ class Replica:
         self.state = HEALTHY
         self.generation = 0
         self.restarts = 0
+        self.integrity = "unverified"
+        self.last_canary: float | None = None
+        self.canary_fails = 0
 
     def active(self) -> int:
         return sum(1 for s in self.slots if s.busy)
@@ -146,6 +153,30 @@ class ReplicaPool:
         self.replayed_total = 0
         self.suspects_total = 0
         self.last_failover_victims = 0
+        # silent-data-corruption detection (ISSUE 10, engine/integrity.py):
+        # the canary/shadow/checksum ledger (plain, readable with
+        # telemetry off), the pool-wide canary golden — ONE golden,
+        # because every replica serves the same weights bit-identically
+        # (the replay contract) — and the load-time weight-checksum
+        # reference every rebuilt replica must match before re-entering
+        # placement. The probe itself belongs to the serving layer
+        # (ApiState._canary_probe): it needs the tokenizer/template.
+        self.sdc_checks_total = 0
+        self.sdc_mismatches_total = 0
+        self.canary_probe = None
+        self.canary_interval_s = 0.0
+        self.canary_fail_threshold = 2
+        self._canary_thread: threading.Thread | None = None
+        self._canary_golden = None
+        self.weights_reference: str | None = None
+        for r in self.replicas:
+            if r.engine is not None:
+                try:
+                    self.weights_reference = r.engine.weights_checksum()
+                except Exception as e:  # a reference is an optimization,
+                    # never a construction blocker (fake/test replicas)
+                    print(f"⚠️ weight checksum unavailable: {e}")
+                break
         for r in self.replicas:
             self._adopt(r)
 
@@ -161,6 +192,12 @@ class ReplicaPool:
         self.tel.replica_state.labels(replica=str(rep.idx)).set(
             STATE_VALUES[rep.state]
         )
+        # a (re)built replica starts integrity-unverified: the next canary
+        # pass re-certifies it against the POOL golden (not a fresh one —
+        # a corrupt-from-rebuild replica must not self-certify)
+        rep.integrity = "unverified"
+        rep.last_canary = None
+        rep.canary_fails = 0
         if sched is None:
             return
         sched.replica_id = rep.idx
@@ -288,6 +325,193 @@ class ReplicaPool:
             self.replayed_total += 1
 
     # ------------------------------------------------------------------
+    # Integrity: SDC canary scheduler + shadow voting (ISSUE 10).
+    # The probe (ApiState._canary_probe) runs a pinned greedy prompt
+    # through the replica's REAL batched path on a directly-claimed lane
+    # — no admission permit (drain never waits on a probe), no tenant
+    # accounting (billed to integrity.CANARY_TENANT) — and returns the
+    # (tokens, fingerprint) pair, or None when inconclusive (lane busy,
+    # canary preempted by real work, replica died mid-probe).
+    # ------------------------------------------------------------------
+
+    def claim_slot(self, idx: int, tenant: str | None = None):
+        """Claim a free lane on replica ``idx`` directly, bypassing fair
+        admission — the canary/shadow path. Prefers the lane with the
+        emptiest chat cache (a probe resets its stream, so taking a lane
+        that holds a live conversation's KV would cost that tenant its
+        next-turn prefix reuse). Returns None when every lane is busy or
+        the replica is dead/closed (the probe is skipped, not queued:
+        integrity checks must never contend with real traffic)."""
+        with self._cond:
+            rep = self.replicas[idx]
+            if rep.state == DEAD or self._closed:
+                return None
+            free = [s for s in rep.slots if not s.busy]
+            if not free:
+                return None
+            slot = min(free, key=lambda s: len(s.cache.items))
+            slot.busy = True
+            slot.tenant = tenant
+            return slot
+
+    def start_canary(self, probe, interval_s: float, fail_threshold: int = 2):
+        """Arm the canary: ``probe(replica, messages=None)`` is the
+        serving layer's pinned-greedy executor. ``interval_s > 0`` starts
+        the background scheduler thread; 0 arms manual :meth:`canary_tick`
+        only (tests, and the shadow-vote path which reuses the probe)."""
+        self.canary_probe = probe
+        self.canary_fail_threshold = max(1, int(fail_threshold))
+        self.canary_interval_s = float(interval_s or 0.0)
+        if self.canary_interval_s > 0 and self._canary_thread is None:
+            self._canary_thread = threading.Thread(
+                target=self._canary_loop, name="dllama-sdc-canary",
+                daemon=True,
+            )
+            self._canary_thread.start()
+
+    def _canary_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                # wait on a MONOTONIC deadline: the pool cond is notified
+                # on every slot release and health event, so a bare
+                # wait(timeout=interval) would wake — and tick — at
+                # traffic frequency instead of the configured cadence
+                deadline = time.monotonic() + self.canary_interval_s
+                while not self._closed:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=left)
+                if self._closed:
+                    return
+            try:
+                self.canary_tick()
+            except Exception as e:
+                # the canary is a health INSTRUMENT: it must never take
+                # the pool down with it
+                print(f"⚠️ sdc canary tick failed: {type(e).__name__}: {e}")
+
+    def canary_tick(self) -> int:
+        """One canary pass over the live replicas; returns the number of
+        CONCLUSIVE probes. The first conclusive result ever seen becomes
+        the pool golden ("recorded at replica build" — the canary starts
+        with the pool); every later probe compares (tokens, fingerprint)
+        against it. A mismatch walks the replica healthy→suspect, and
+        ``canary_fail_threshold`` consecutive mismatches declare it DEAD
+        **as corrupt** (victims get ReplicaCorrupt — the serving layer
+        never splices a replay onto possibly-corrupt sent deltas); a
+        match re-certifies integrity and clears a suspect replica the
+        same way a fast dispatch round-trip does."""
+        probe = self.canary_probe
+        if probe is None:
+            return 0
+        with self._cond:
+            if self._closed:
+                return 0
+            todo = [
+                (r, r.generation) for r in self.replicas if r.state != DEAD
+            ]
+        conclusive = 0
+        for rep, gen in todo:
+            sw = Stopwatch()
+            try:
+                result = probe(rep)
+            except Exception as e:
+                print(
+                    f"⚠️ canary probe on replica {rep.idx} failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+                result = None
+            if result is not None:
+                # conclusive probes only: a busy-lane skip returns in
+                # microseconds and would flood the histogram with
+                # healthy-looking near-zero samples exactly when probes
+                # are NOT running
+                self.tel.canary_latency.observe(sw.elapsed_s())
+            kill_gen = None
+            with self._cond:
+                if self._closed:
+                    return conclusive
+                if rep.generation != gen or rep.state == DEAD:
+                    continue  # replaced or died mid-probe: stale result
+                if result is None:
+                    continue
+                conclusive += 1
+                rep.last_canary = time.monotonic()
+                self.sdc_checks_total += 1
+                self.tel.sdc_checks.inc()
+                if self._canary_golden is None:
+                    self._canary_golden = result
+                    rep.integrity = "ok"
+                    rep.canary_fails = 0
+                elif result == self._canary_golden:
+                    rep.integrity = "ok"
+                    rep.canary_fails = 0
+                    if rep.state == SUSPECT:
+                        # a full pinned greedy round trip through the real
+                        # batched path matching the golden is at least as
+                        # strong a recovery signal as a fast heartbeat
+                        self._set_state_locked(rep, HEALTHY)
+                else:
+                    rep.integrity = "mismatch"
+                    rep.canary_fails += 1
+                    self.sdc_mismatches_total += 1
+                    self.tel.sdc_mismatches.labels(check="canary").inc()
+                    if rep.canary_fails >= self.canary_fail_threshold:
+                        kill_gen = gen
+                    elif rep.state == HEALTHY:
+                        self._set_state_locked(rep, SUSPECT)
+            if kill_gen is not None:
+                # outside the pool cond: mark_lost takes the scheduler
+                # cond and hooks back into _on_event (lock order is
+                # scheduler → pool, never the reverse)
+                cause = (
+                    f"silent data corruption: {rep.canary_fails} "
+                    "consecutive canary mismatches against the pool golden"
+                )
+                if rep.scheduler is not None:
+                    rep.scheduler.mark_lost(cause, corrupt=True)
+                else:
+                    self._on_event(rep.idx, kill_gen, "lost", 0.0)
+        return conclusive
+
+    def shadow_vote(self, probe, messages) -> bool | None:
+        """Cross-replica shadow vote (optional, N ≥ 2): re-execute a
+        greedy request's prompt on two live replicas through the probe
+        machinery and compare (tokens, fingerprint). Divergence proves
+        one of them is silently corrupt; with only two opinions the
+        minority is unknowable, so BOTH turn suspect and the next canary
+        passes resolve them — the corrupt replica walks on to dead, the
+        healthy one's matching canary clears it. Returns True (agree),
+        False (diverged), None (inconclusive)."""
+        with self._cond:
+            live = [r for r in self.replicas if r.state != DEAD]
+            if len(live) < 2 or self._closed:
+                return None
+            # a RANDOM pair (the entropy rng — which replicas a vote
+            # covers must not be fleet-synchronized either): a fixed
+            # live[:2] would leave replicas at index >= 2 structurally
+            # outside shadow coverage forever
+            pair = self._rng.sample(live, 2)
+        votes = [probe(rep, messages) for rep in pair]
+        if any(v is None for v in votes):
+            return None
+        with self._cond:
+            self.sdc_checks_total += 1
+            self.tel.sdc_checks.inc()
+            if votes[0] == votes[1]:
+                return True
+            self.sdc_mismatches_total += 1
+            self.tel.sdc_mismatches.labels(check="shadow").inc()
+            for rep in pair:
+                if rep.state == HEALTHY:
+                    self._set_state_locked(rep, SUSPECT)
+            self._cond.notify_all()
+            return False
+
+    # ------------------------------------------------------------------
     # Health state machine (hook events arrive from scheduler threads,
     # possibly under the scheduler's cond — this side takes only _cond)
     # ------------------------------------------------------------------
@@ -356,7 +580,16 @@ class ReplicaPool:
         def build():
             if self._closed:
                 raise RuntimeError("pool closed; not restarting")
-            return self.build_replica(idx)
+            engine, scheduler, slots = self.build_replica(idx)
+            try:
+                self._verify_rebuild(idx, engine)
+            except BaseException:
+                # a corrupt rebuild never re-enters placement: tear down
+                # its watchdog and let the backoff loop try again
+                if scheduler is not None:
+                    scheduler.close()
+                raise
+            return engine, scheduler, slots
 
         def on_retry(attempt, exc):
             if self._closed:
@@ -394,6 +627,29 @@ class ReplicaPool:
         if dead is not None:
             dead.close()
 
+    def _verify_rebuild(self, idx: int, engine) -> None:
+        """Weight-checksum verification of a rebuilt replica (ISSUE 10):
+        the rebuild re-read the weights through the same host RAM / disk /
+        cores that may have corrupted the replica in the first place, so
+        it must prove byte-level agreement with the pool's load-time
+        reference BEFORE re-entering placement. A mismatch raises
+        :class:`integrity.ChecksumMismatch` — the restart loop counts it
+        as a failed attempt and retries under backoff."""
+        if engine is None or self.weights_reference is None:
+            return
+        got = integrity.params_checksum(engine.params)
+        with self._cond:
+            self.sdc_checks_total += 1
+        self.tel.sdc_checks.inc()
+        if got != self.weights_reference:
+            with self._cond:
+                self.sdc_mismatches_total += 1
+            self.tel.sdc_mismatches.labels(check="checksum").inc()
+            raise integrity.ChecksumMismatch(
+                f"replica {idx} rebuild checksum {got} != pool reference "
+                f"{self.weights_reference}; refusing to re-enter placement"
+            )
+
     # ------------------------------------------------------------------
     # Introspection (/readyz, tests)
     # ------------------------------------------------------------------
@@ -401,6 +657,7 @@ class ReplicaPool:
     def snapshot(self) -> list[dict]:
         """Per-replica health for the /readyz JSON body
         (docs/OBSERVABILITY.md "Readiness schema")."""
+        now = time.monotonic()
         with self._cond:
             return [
                 {
@@ -409,6 +666,16 @@ class ReplicaPool:
                     "active_rows": r.active(),
                     "slots": len(r.slots),
                     "restarts": r.restarts,
+                    # SDC canary read (ISSUE 10): "unverified" until the
+                    # first conclusive probe of this generation, then
+                    # "ok"/"mismatch"; age None while unprobed. A
+                    # balancer can shed a replica whose canary is stale
+                    # or failing before the pool walks it to dead
+                    "integrity": r.integrity,
+                    "last_canary_age_s": (
+                        None if r.last_canary is None
+                        else round(now - r.last_canary, 3)
+                    ),
                 }
                 for r in self.replicas
             ]

@@ -490,6 +490,31 @@ class ServerInstruments:
             "died mid-flight (pinned seed, sent SSE deltas suppressed — "
             "the stream is bit-identical to an unfaulted run)",
         )
+        # silent-data-corruption detection (ISSUE 10, engine/integrity.py
+        # + server/replicas.py): canary probes, shadow votes and restart
+        # weight-checksum verifications all count as checks; mismatches
+        # carry which check caught the corruption
+        self.sdc_checks = counter(
+            "dllama_sdc_checks_total",
+            "Conclusive integrity checks performed: canary golden "
+            "comparisons, cross-replica shadow votes, and rebuild "
+            "weight-checksum verifications (a clean fleet moves this "
+            "without ever moving the mismatch counter)",
+        )
+        self.sdc_mismatches = counter(
+            "dllama_sdc_mismatches_total",
+            "Integrity checks that detected silent data corruption, by "
+            "which check caught it (canary = pinned-greedy golden "
+            "mismatch, shadow = cross-replica divergence, checksum = a "
+            "rebuilt replica's weights disagree with the load-time "
+            "reference)",
+            labelnames=("check",),
+        )
+        self.canary_latency = histogram(
+            "dllama_canary_latency_seconds",
+            "Wall time of one SDC canary probe (pinned greedy prompt "
+            "through the replica's real batched path on a reserved lane)",
+        )
 
 
 class SamplerInstruments:

@@ -243,6 +243,20 @@ class Sampler:
 
     def sample(self, logits: np.ndarray) -> int:
         logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
+        if not np.isfinite(logits).all():
+            # validate BEFORE sampling (ISSUE 10 satellite): NaN/Inf
+            # logits pushed through the softmax/CDF below launder into a
+            # perfectly in-vocab token id — the device path's
+            # out-of-vocab check never sees it, and greedy argmax just
+            # returns the first NaN's index. Fail typed instead; the
+            # serving layer retires the request like any corrupt chunk.
+            from distributed_llama_tpu.engine import faults
+
+            raise faults.NonFiniteLogits(
+                "host sampler got non-finite logits "
+                f"({int((~np.isfinite(logits)).sum())} of {logits.size} "
+                "entries); refusing to sample a plausible-but-wrong token"
+            )
         if self.temperature == 0.0:
             self._tel.sampled.labels(method="greedy").inc()
             return int(np.argmax(logits))

@@ -514,3 +514,93 @@ class TestPreemption:
             )
         finally:
             server.shutdown()
+
+
+class TestResizeConcurrency:
+    """Concurrent FairAdmission.resize interleavings (ISSUE 10 satellite):
+    positive and negative capacity deltas — the replica pool's
+    death/restart lever — racing acquire/release traffic and the victim
+    unwind (permits released while capacity is already shrunk, the
+    transiently-negative ``_free`` window)."""
+
+    def test_resize_deltas_race_traffic_and_victim_unwind(self):
+        adm = FairAdmission(8, queue_limit=256)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+        served = [0] * 6
+
+        def worker(i):
+            try:
+                while not stop.is_set():
+                    try:
+                        adm.acquire(f"t{i % 2}")
+                    except AdmissionRejected:
+                        continue
+                    # hold the permit across resize windows: this is the
+                    # "victim" whose release lands on shrunk capacity
+                    time.sleep(0.0005)
+                    adm.release()
+                    served[i] += 1
+            except BaseException as e:  # noqa: BLE001 — the assertion surface
+                errors.append(e)
+                stop.set()
+
+        def resizer(delta, rounds):
+            try:
+                for _ in range(rounds):
+                    adm.resize(-delta)
+                    time.sleep(0.001)
+                    adm.resize(+delta)
+            except BaseException as e:
+                errors.append(e)
+                stop.set()
+
+        workers = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        # two resizers: worst-case interleaving shrinks 8 -> 2 while six
+        # workers hold/queue permits (negative-_free territory)
+        resizers = [
+            threading.Thread(target=resizer, args=(4, 120), daemon=True),
+            threading.Thread(target=resizer, args=(2, 120), daemon=True),
+        ]
+        for t in workers + resizers:
+            t.start()
+        for t in resizers:
+            t.join(timeout=60)
+            assert not t.is_alive(), "resizer wedged"
+        stop.set()
+        with adm._cond:
+            adm._cond.notify_all()
+        for t in workers:
+            t.join(timeout=60)
+            assert not t.is_alive(), "worker wedged"
+        assert not errors, errors
+        # capacity restored exactly: every -delta was paired with +delta
+        assert adm.n_slots == 8
+        assert sum(served) > 0
+        # all permits home once the dust settles (no lost or minted slots)
+        deadline = time.monotonic() + 10
+        while adm.free_slots() != adm.n_slots:
+            assert time.monotonic() < deadline, (
+                f"permits never drained: free={adm.free_slots()} "
+                f"slots={adm.n_slots}"
+            )
+            time.sleep(0.005)
+
+    def test_resize_negative_window_rejects_only_overdraw(self):
+        # the deterministic edge: capacity can reach 0 with a permit in
+        # flight (free goes negative), and only a true overdraw raises
+        adm = FairAdmission(4)
+        for _ in range(3):
+            adm.acquire("a")
+        adm.resize(-4)
+        assert adm.n_slots == 0 and adm.free_slots() == -3
+        with pytest.raises(ValueError):
+            adm.resize(-1)
+        for _ in range(3):
+            adm.release()
+        assert adm.free_slots() == 0
+        adm.resize(4)
+        assert adm.free_slots() == 4

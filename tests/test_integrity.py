@@ -1,0 +1,683 @@
+"""Silent-data-corruption detection (ISSUE 10): logit fingerprints,
+weight checksums, the canary scheduler, shadow voting, and
+corrupt-replica failover semantics.
+
+Layers, mirroring the subsystem:
+
+* ``engine/integrity.py`` primitives — fingerprint fold determinism and
+  NaN-witnessing, pack/split round trips, bit-level checksum sensitivity,
+  deterministic finite corruption.
+* The **sampled-path finiteness regressions** — the host ``Sampler`` and
+  the batched device path both refuse to launder non-finite logits into
+  plausible in-vocab tokens (pre-ISSUE-10 behavior: silent garbage).
+* The ``engine.sdc`` chaos site — ``kind=corrupt`` is SILENT (no raise,
+  no quarantine, counters move) while changing the stream: exactly the
+  class every earlier check is blind to.
+* Serving-level acceptance over real HTTP — weight corruption on one of
+  two replicas detected by the canary within the mismatch threshold, the
+  victim walking suspect→dead-as-corrupt, **no request ever completing
+  with silently-wrong content**, mid-stream victims ending with a typed
+  ``replica_corrupt`` error instead of a spliced replay, zero-delta
+  victims replaying cleanly, and the restarted replica passing
+  weight-checksum verification before re-entering placement.
+
+Everything runs on tiny seeded synthetic models under JAX_PLATFORMS=cpu.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.engine import InferenceEngine, faults, integrity
+from distributed_llama_tpu.engine.batch import BatchScheduler
+from distributed_llama_tpu.server.replicas import HEALTHY, SUSPECT
+from distributed_llama_tpu.tokenizer import Sampler
+
+from tests.test_batch_decode import build_engine
+from tests.test_faults import get, post_raw, serve_state
+from tests.test_fair_sched import SseStream
+from tests.test_replicas import _SLOW, _one_long_prompt, make_replica_state
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ----------------------------------------------------------------------
+# Primitives
+# ----------------------------------------------------------------------
+
+
+class TestPrimitives:
+    def test_fingerprint_fold_deterministic_and_sensitive(self):
+        h, ok = integrity.fingerprint_init(3)
+        logits = jnp.asarray(
+            np.random.RandomState(0).randn(3, 33), jnp.float32
+        )
+        toks = jnp.asarray([4, 7, 9], jnp.int32)
+        a1, _ = integrity.fingerprint_fold(h, ok, logits, toks)
+        a2, _ = integrity.fingerprint_fold(h, ok, logits, toks)
+        assert (np.asarray(a1) == np.asarray(a2)).all()
+        # an argmax-flipping logit change in ONE row moves that row's
+        # hash only (ulp-level drift deliberately does NOT — the fold is
+        # an order statistic so bucket-shape recompiles can't flap it)
+        bumped = logits.at[1, 0].add(100.0)
+        b, _ = integrity.fingerprint_fold(h, ok, bumped, toks)
+        a, b = np.asarray(a1), np.asarray(b)
+        assert a[1] != b[1] and a[0] == b[0] and a[2] == b[2]
+        ulp = logits.at[2, 0].add(1e-6)
+        u, _ = integrity.fingerprint_fold(h, ok, ulp, toks)
+        assert (np.asarray(u) == a).all()
+        # a token change moves the hash even at identical logits
+        c, _ = integrity.fingerprint_fold(
+            h, ok, logits, jnp.asarray([4, 7, 10], jnp.int32)
+        )
+        assert np.asarray(c)[2] != a[2]
+
+    def test_fingerprint_fold_witnesses_nonfinite(self):
+        h, ok = integrity.fingerprint_init(2)
+        logits = jnp.ones((2, 8), jnp.float32)
+        for poison in (np.nan, np.inf, -np.inf):
+            _, ok2 = integrity.fingerprint_fold(
+                h, ok, logits.at[1, 3].set(poison), jnp.zeros(2, jnp.int32)
+            )
+            assert list(np.asarray(ok2)) == [True, False], poison
+        # ...and the flag LATCHES across steps
+        h2, ok2 = integrity.fingerprint_fold(
+            h, ok, logits.at[0, 0].set(np.nan), jnp.zeros(2, jnp.int32)
+        )
+        _, ok3 = integrity.fingerprint_fold(
+            h2, ok2, logits, jnp.zeros(2, jnp.int32)
+        )
+        assert list(np.asarray(ok3)) == [False, True]
+
+    def test_pack_split_round_trip(self):
+        h, ok = integrity.fingerprint_init(4)
+        toks = jnp.asarray(np.arange(12, dtype=np.int32).reshape(3, 4))
+        h = h + jnp.uint32(7)
+        ok = ok.at[2].set(False)
+        packed = np.asarray(integrity.pack_chunk_outputs(toks, h, ok))
+        assert packed.shape == (5, 4)
+        t, fp, fin = integrity.split_chunk_outputs(packed, 3)
+        assert (t == np.asarray(toks)).all()
+        assert (fp == np.asarray(h)).all() and fp.dtype == np.uint32
+        assert list(fin) == [True, True, False, True]
+
+    def test_checksum_detects_single_bit_flip(self):
+        import ml_dtypes
+
+        params = {
+            "w": jnp.asarray(np.random.RandomState(1).randn(33, 5), jnp.float32),
+            "b": jnp.ones((64,), jnp.bfloat16),
+        }
+        ref = integrity.params_checksum(params)
+        assert ref == integrity.params_checksum(params)  # deterministic
+        # one mantissa bit in the bf16 leaf: a float32 accumulation would
+        # round this away; the word sum cannot
+        raw = np.asarray(params["b"]).view(np.uint16).copy()
+        raw[17] ^= 1
+        flipped = dict(params, b=jnp.asarray(raw.view(ml_dtypes.bfloat16)))
+        assert integrity.params_checksum(flipped) != ref
+
+    def test_corrupt_params_is_finite_detected_and_skips_embeddings(self):
+        params = {
+            "token_embedding": jnp.ones((16, 4), jnp.float32),
+            "layers": [{"wq": jnp.ones((8, 8), jnp.float32)}],
+        }
+        ref = integrity.params_checksum(params)
+        for seed in range(4):
+            bad, desc = integrity.corrupt_params(params, seed=seed)
+            assert "embed" not in desc.lower()
+            assert integrity.params_checksum(bad) != ref
+            for leaf in [bad["layers"][0]["wq"], bad["token_embedding"]]:
+                assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_check_expected_zero(self):
+        from distributed_llama_tpu.loadgen.report import check_expected_zero
+
+        ok = check_expected_zero({"server": {"a": 0.0, "b": 2.0}}, ["a"])
+        assert ok["ok"]
+        bad = check_expected_zero({"server": {"a": 0.0, "b": 2.0}}, ["a", "b"])
+        assert not bad["ok"] and any("'b'" in v for v in bad["violations"])
+        # a missing series reads as 0 (telemetry may be off)...
+        assert check_expected_zero({"server": {}}, ["c"])["ok"]
+        # ...but a failed scrape must not pass vacuously
+        assert not check_expected_zero({"server": None}, ["a"])["ok"]
+
+
+# ----------------------------------------------------------------------
+# Sampled-path finiteness (the satellite fix + its device twin)
+# ----------------------------------------------------------------------
+
+
+class TestNonFiniteLogits:
+    def test_host_sampler_refuses_nonfinite_logits(self):
+        """Regression (discriminating): the pre-fix sampler softmaxed NaN
+        logits into a CDF and returned a plausible in-vocab id — silent
+        corruption. Now every host sampling mode fails typed, and the
+        type is a RowQuarantined so the serving layer retires the request
+        like any corrupt chunk."""
+        assert issubclass(faults.NonFiniteLogits, faults.RowQuarantined)
+        logits = np.zeros(16, np.float32)
+        logits[3] = np.nan
+        for temperature, topp in ((0.0, 0.9), (0.8, 0.9), (0.8, 1.0)):
+            s = Sampler(vocab_size=16, temperature=temperature, topp=topp, seed=3)
+            with pytest.raises(faults.NonFiniteLogits):
+                s.sample(logits)
+        # clean logits still sample
+        s = Sampler(vocab_size=16, temperature=0.8, topp=0.9, seed=3)
+        assert 0 <= s.sample(np.arange(16, dtype=np.float32)) < 16
+
+    def test_batched_device_path_quarantines_nonfinite_row(self, tmp_path):
+        """The device twin: NaN weights make every logit row NaN; the
+        sampled token can still be in-vocab (argmax/categorical of NaN is
+        an index, not an error), so the old out-of-vocab check passed it
+        through. The per-chunk finiteness flag now quarantines the row
+        with the typed NonFiniteLogits."""
+        engine = build_engine(tmp_path, "nf.m")
+        flat, treedef = __import__("jax").tree_util.tree_flatten(engine.params)
+        poisoned = [
+            jnp.full_like(leaf, np.nan)
+            if i == len(flat) - 1 and jnp.issubdtype(leaf.dtype, jnp.floating)
+            else leaf
+            for i, leaf in enumerate(flat)
+        ]
+        engine.params = treedef.unflatten(poisoned)
+        sched = BatchScheduler(engine, n_rows=2, chunk=4)
+        s = sched.new_stream()
+        first, key = s.prefill_device([1, 5, 9], 0.8, 0.9, 7)  # SAMPLED path
+        with pytest.raises(faults.NonFiniteLogits):
+            s.stream_decode(
+                first, lambda p, t: True, 0.8, 0.9, seed=7, key=key,
+                first_prev=9, limit=s.pos + 12,
+            )
+        sched.close()
+
+
+# ----------------------------------------------------------------------
+# The engine.sdc chaos site (kind=corrupt is SILENT)
+# ----------------------------------------------------------------------
+
+
+def _greedy_batch_tokens(sched, prompt, n):
+    s = sched.new_stream()
+    first, key = s.prefill_device(prompt, 0.0, 0.9, 0)
+    got = []
+
+    def on_token(prev, tok):
+        got.append(int(tok))
+        return len(got) < n
+
+    s.stream_decode(
+        first, on_token, 0.0, 0.9, seed=0, key=key, first_prev=prompt[-1],
+        limit=s.pos + n,
+    )
+    # fold exactly the chunks behind the consumed tokens: the pipelined
+    # extra chunk's delivery races the stream's leave (run_fingerprint's
+    # determinism contract)
+    fp = s.run_fingerprint(len(got) - 1)
+    s.reset()
+    return got, fp
+
+
+class TestSdcSite:
+    PROMPT = [1, 5, 9, 2, 8]
+
+    def test_corrupt_weights_is_silent_but_changes_the_stream(self, tmp_path):
+        ref_sched = BatchScheduler(build_engine(tmp_path, "ref.m"), 2, chunk=4)
+        ref, ref_fp = _greedy_batch_tokens(ref_sched, self.PROMPT, 12)
+        ref_sched.close()
+
+        plan = faults.install(
+            faults.parse("engine.sdc:kind=corrupt,row=0,count=1")
+        )
+        sched = BatchScheduler(build_engine(tmp_path, "sdc.m"), 2, chunk=4)
+        got, fp = _greedy_batch_tokens(sched, self.PROMPT, 12)
+        sched.close()
+        assert plan.injected_total == 1  # it FIRED...
+        assert len(got) == 12  # ...and nothing raised or quarantined
+        # the decode ran on perturbed weights: the fingerprint (bit-exact
+        # logit sums) must move even if every greedy argmax survived
+        assert (got, fp) != (ref, ref_fp)
+
+    def test_corrupt_logits_mode_shifts_one_chunk_in_vocab(self, tmp_path):
+        ref_sched = BatchScheduler(build_engine(tmp_path, "r2.m"), 2, chunk=4)
+        ref, _ = _greedy_batch_tokens(ref_sched, self.PROMPT, 12)
+        ref_sched.close()
+
+        faults.install(faults.parse(
+            "engine.sdc:kind=corrupt,message=logits,row=0,count=1"
+        ))
+        engine = build_engine(tmp_path, "l2.m")
+        vocab = engine.cfg.vocab_size
+        sched = BatchScheduler(engine, 2, chunk=4)
+        got, _ = _greedy_batch_tokens(sched, self.PROMPT, 12)
+        sched.close()
+        # the fused first token precedes chunk 1 and is untouched; chunk 1
+        # (4 tokens) is shifted in-vocab; the device state never saw the
+        # host-side corruption, so later chunks continue the clean stream
+        assert got[0] == ref[0]
+        assert got[1:5] == [(t + 1) % vocab for t in ref[1:5]]
+        assert got[5:] == ref[5:]
+        assert all(0 <= t < vocab for t in got)  # invisible to validation
+
+    def test_stream_fingerprint_is_stable_per_weights(self, tmp_path):
+        engine = build_engine(tmp_path, "fp.m")
+        # 4 rows: each run takes a fresh lane — the second run rides a
+        # BIGGER bucket than the first (1 → 2), which is exactly the
+        # shape change the order-statistic fold must shrug off
+        sched = BatchScheduler(engine, 4, chunk=4)
+        a, fp_a = _greedy_batch_tokens(sched, self.PROMPT, 8)
+        b, fp_b = _greedy_batch_tokens(sched, self.PROMPT, 8)
+        assert (a, fp_a) == (b, fp_b)  # one healthy value per weights
+        engine.params, _ = integrity.corrupt_params(engine.params, seed=3)
+        c, fp_c = _greedy_batch_tokens(sched, self.PROMPT, 8)
+        assert (c, fp_c) != (a, fp_a)
+        sched.close()
+
+
+# ----------------------------------------------------------------------
+# Canary scheduler + shadow voting + corrupt-failover (serving level)
+# ----------------------------------------------------------------------
+
+
+def _tick_until(pool, pred, max_ticks=20):
+    """Run manual canary ticks until ``pred()`` holds; returns the tick
+    count (the 'detected within K canary periods' meter)."""
+    for i in range(1, max_ticks + 1):
+        pool.canary_tick()
+        if pred():
+            return i
+    raise AssertionError(f"not detected within {max_ticks} canary ticks")
+
+
+@pytest.mark.chaos
+class TestCanary:
+    def test_canary_records_golden_certifies_and_reports(self, tmp_path):
+        state = make_replica_state(tmp_path, "cn", replicas=2, parallel=2)
+        url, server = serve_state(state)
+        try:
+            pool = state.pool
+            assert pool.canary_probe is not None  # armed at ApiState build
+            assert pool.weights_reference is not None
+            assert pool.canary_tick() == 2  # both replicas conclusive
+            assert pool.canary_tick() == 2  # and again, against the golden
+            assert pool.sdc_checks_total >= 4
+            assert pool.sdc_mismatches_total == 0  # zero false positives
+            assert [r.integrity for r in pool.replicas] == ["ok", "ok"]
+
+            import json as _json
+
+            status, raw = get(url, "/readyz")
+            assert status == 200
+            body = _json.loads(raw)
+            for rep in body["replicas"]:
+                assert rep["integrity"] == "ok"
+                assert isinstance(rep["last_canary_age_s"], float)
+                assert rep["last_canary_age_s"] >= 0.0
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_corruption_detected_victim_fails_over_restart_verified(
+        self, tmp_path
+    ):
+        """The ISSUE 10 acceptance: weight corruption lands on replica 0
+        while two victims stream from it — (a) the canary detects within
+        the mismatch threshold's worth of ticks and walks the replica
+        suspect→dead AS CORRUPT, (b) NO victim completes with
+        silently-wrong content: mid-stream victims end with the typed
+        `replica_corrupt` error (their sent deltas are untrustworthy —
+        replaying under delta suppression would splice), (c) new traffic
+        serves clean from the survivor, (d) the supervisor's rebuild
+        passes weight-checksum verification, re-enters placement, and the
+        canary re-certifies it against the SAME pool golden."""
+        clean = make_replica_state(
+            tmp_path, "clean", replicas=2, parallel=3, max_seq=320
+        )
+        url, server = serve_state(clean)
+        try:
+            prompt, _ = _one_long_prompt(url)
+            _, _, b224 = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 224},
+            )
+            baseline = b224["choices"][0]["message"]["content"]
+            _, _, b8 = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 8},
+            )
+            baseline8 = b8["choices"][0]["message"]["content"]
+        finally:
+            server.shutdown()
+            clean.pool.close()
+
+        # slow fetches stretch the victims' decode (224 tokens, 56 delayed
+        # chunks ≈ several seconds) across the whole detection window — a
+        # delay corrupts nothing; short canary probes keep each tick fast
+        faults.install(faults.parse(_SLOW.replace("delay_ms=25", "delay_ms=80")))
+        state = make_replica_state(
+            tmp_path, "sdc", replicas=2, parallel=3, max_seq=320,
+            sdc_canary_tokens=4,
+        )
+        url, server = serve_state(state)
+        try:
+            pool = state.pool
+            reference = pool.weights_reference
+            # pin replica 1 so this phase's traffic lands on replica 0
+            for s in pool.replicas[1].slots:
+                s.busy = True
+            # pre-warm the bucket-4 batched program (3 live rows + the
+            # probe row reach bucket 4): the compile must not eat the
+            # detection window
+            warm = [
+                SseStream(url, {
+                    "messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": 8,
+                })
+                for _ in range(3)
+            ]
+            for s in warm:
+                s.read_first_delta()
+                s.read_rest()
+            for s in pool.replicas[1].slots:
+                s.busy = False
+            assert pool.canary_tick() == 2  # golden recorded, both ok
+
+            for s in pool.replicas[1].slots:
+                s.busy = True
+            body = {"messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": 224}
+            streams = [SseStream(url, dict(body)) for _ in range(2)]
+            firsts = [s.read_first_delta() for s in streams]
+            assert all(firsts)  # both victims are mid-stream
+            for s in pool.replicas[1].slots:
+                s.busy = False
+
+            # the corruption moment: replica 0's weights flip mid-decode
+            rep0 = pool.replicas[0]
+            rep0.engine.params, desc = integrity.corrupt_params(
+                rep0.engine.params, seed=1
+            )
+            # (a) detection within the threshold (2 mismatches) plus one
+            # slack tick for a probe that raced the corruption moment.
+            # The latch is the failover LEDGER: the 0.05s-backoff
+            # supervisor can rebuild the replica to HEALTHY before the
+            # tick even returns, so the transient DEAD state is not a
+            # reliable observable
+            ticks = _tick_until(
+                pool, lambda: pool.failovers_total >= 1, max_ticks=6
+            )
+            assert ticks <= pool.canary_fail_threshold + 1, (ticks, desc)
+            assert pool.sdc_mismatches_total >= pool.canary_fail_threshold
+            assert pool.failovers_total == 1
+
+            # (b) the victims: mid-stream when their replica died corrupt,
+            # so each ends with the TYPED error — never a completion with
+            # wrong bytes, never a spliced replay
+            texts = [f + s.read_rest() for f, s in zip(firsts, streams)]
+            for s, text in zip(streams, texts):
+                if s.error_type is None:
+                    # completed: only legitimate if every delta matches
+                    # the clean baseline (all sent before the corruption)
+                    assert text == baseline
+                else:
+                    assert s.error_type == "replica_corrupt"
+            assert any(s.error_type == "replica_corrupt" for s in streams)
+            assert pool.replayed_total == 0  # no sent-delta victim replayed
+
+            # (c) the survivor serves clean traffic immediately
+            status, _, after = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 8},
+            )
+            assert status == 200
+            assert after["choices"][0]["message"]["content"] == baseline8
+
+            # (d) the rebuild passes checksum verification and re-enters
+            assert pool.wait_state(0, HEALTHY, timeout_s=60)
+            assert pool.restarts_total == 1
+            assert pool.weights_reference == reference
+            assert integrity.params_checksum(
+                pool.replicas[0].engine.params
+            ) == reference
+            # the canary re-certifies the rebuilt replica against the
+            # SAME pool golden (a corrupt rebuild could not self-certify)
+            assert pool.replicas[0].integrity == "unverified"
+            _tick_until(
+                pool, lambda: pool.replicas[0].integrity == "ok", max_ticks=4
+            )
+            assert pool.replicas[0].state == HEALTHY
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_corrupt_rebuild_is_rejected_then_clean_rebuild_enters(
+        self, tmp_path
+    ):
+        """Restart-time weight-checksum verification: a factory whose
+        first rebuild returns corrupted weights is refused re-entry
+        (counted as check=checksum mismatch) and the loop retries until a
+        clean build matches the reference."""
+        state = make_replica_state(tmp_path, "rv", replicas=2, parallel=2)
+        pool = state.pool
+        orig_build = pool.build_replica
+        corrupted_once = []
+
+        def sabotaging_build(idx):
+            engine, sched, slots = orig_build(idx)
+            if not corrupted_once:
+                corrupted_once.append(1)
+                engine.params, _ = integrity.corrupt_params(engine.params)
+            return engine, sched, slots
+
+        pool.build_replica = sabotaging_build
+        before = pool.sdc_mismatches_total
+        pool.mark_dead(0, "test")
+        try:
+            assert pool.wait_state(0, HEALTHY, timeout_s=60)
+            assert corrupted_once  # the sabotaged build happened...
+            assert pool.sdc_mismatches_total == before + 1  # ...was caught
+            assert pool.restarts_total == 1  # and only the CLEAN one entered
+            assert integrity.params_checksum(
+                pool.replicas[0].engine.params
+            ) == pool.weights_reference
+        finally:
+            pool.close()
+
+    def test_shadow_vote_divergence_suspects_both_canary_resolves(
+        self, tmp_path
+    ):
+        state = make_replica_state(tmp_path, "sh", replicas=2, parallel=2)
+        pool = state.pool
+        msgs = [{"role": "user", "content": "hello shadow"}]
+        try:
+            assert pool.shadow_vote(state._canary_probe, msgs) is True
+            assert pool.sdc_mismatches_total == 0
+            # corrupt replica 1: the vote diverges, BOTH turn suspect
+            # (two opinions cannot name the minority)...
+            pool.replicas[1].engine.params, _ = integrity.corrupt_params(
+                pool.replicas[1].engine.params, seed=2
+            )
+            assert pool.shadow_vote(state._canary_probe, msgs) is False
+            assert pool.sdc_mismatches_total == 1
+            assert {r.state for r in pool.replicas} == {SUSPECT}
+            # ...and the canary resolves them: replica 0 matches the
+            # golden and clears; replica 1 keeps mismatching and dies
+            # (the failover ledger is the latch — the supervisor can
+            # rebuild the dead replica before a state read lands)
+            _tick_until(pool, lambda: pool.failovers_total >= 1,
+                        max_ticks=6)
+            assert pool.replicas[0].state == HEALTHY
+            assert pool.replicas[0].integrity == "ok"
+            # the corrupt replica walked suspect→dead-as-corrupt and its
+            # supervised rebuild (same weights file → checksum passes)
+            # re-enters placement healthy
+            assert pool.wait_state(1, HEALTHY, timeout_s=60)
+            assert pool.replicas[1].restarts == 1
+        finally:
+            pool.close()
+
+    def test_mid_stream_corrupt_loss_is_typed_not_spliced(self, tmp_path):
+        """Discriminating regression for the no-splice contract: a plain
+        ReplicaLost mid-stream replays under delta suppression (PR 9);
+        a CORRUPT loss must not — the sent deltas are untrustworthy. The
+        stream ends with the typed `replica_corrupt` error and the replay
+        counter stays still, even though a healthy replica was free."""
+        faults.install(faults.parse(_SLOW))
+        state = make_replica_state(tmp_path, "ts", replicas=2, parallel=2)
+        url, server = serve_state(state)
+        try:
+            stream = SseStream(url, {
+                "messages": [{"role": "user", "content": "tell me a story"}],
+                "max_tokens": 64,
+            })
+            first = stream.read_first_delta()
+            assert first  # deltas are out
+            victim_rep = next(
+                r for r in state.pool.replicas if r.active() > 0
+            )
+            victim_rep.scheduler.mark_lost(
+                "sdc canary mismatch (test)", corrupt=True
+            )
+            stream.read_rest()
+            assert stream.error_type == "replica_corrupt"
+            assert state.pool.replayed_total == 0
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_corrupt_loss_before_any_delta_replays_cleanly(self, tmp_path):
+        """The other half of the contract: a ReplicaCorrupt victim that
+        streamed NOTHING replays like any replica loss — no corrupt byte
+        ever reached the client, so the replay is safe (and counted)."""
+        state = make_replica_state(tmp_path, "rc0", replicas=1, parallel=2)
+        orig_place = state.pool.place
+        bounced = []
+
+        def place_corrupt_once(messages, deadline=None):
+            if not bounced:
+                bounced.append(1)
+                raise faults.ReplicaCorrupt("replica 0 lost: sdc (test)")
+            return orig_place(messages, deadline)
+
+        state.pool.place = place_corrupt_once
+        try:
+            out = state.complete(
+                {"messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 4},
+                lambda s: None,
+            )
+            assert out["choices"][0]["message"]["content"] is not None
+            assert bounced and state.pool.replayed_total == 1
+        finally:
+            state.pool.close()
+
+    def test_canary_does_not_block_drain(self, tmp_path):
+        """The canary-vs-drain race (ISSUE 10 satellite): probes hold no
+        admission permit, so a drain completes while a canary is still
+        mid-probe — and the probe unwinds cleanly afterwards."""
+        faults.install(faults.parse(
+            "batch.fetch:kind=delay,delay_ms=150,count=-1"
+        ))
+        state = make_replica_state(tmp_path, "dr", replicas=1, parallel=2)
+        pool = state.pool
+        done: list[int] = []
+        t = threading.Thread(
+            target=lambda: done.append(pool.canary_tick()), daemon=True
+        )
+        t.start()
+        time.sleep(0.1)  # let the probe claim its lane / start decoding
+        state.begin_drain()
+        sw = time.monotonic()
+        assert state.admission.drain_wait(5.0) is True
+        assert time.monotonic() - sw < 2.0  # did not wait out the canary
+        t.join(timeout=60)
+        assert not t.is_alive() and done
+        # every lane is free again: the probe released its claim
+        assert all(not s.busy for s in pool.all_slots())
+        assert state.admission.free_slots() == state.admission.n_slots
+        pool.close()
+
+    def test_client_cannot_use_reserved_tenant(self, tmp_path):
+        state = make_replica_state(tmp_path, "rt", replicas=1, parallel=2)
+        try:
+            with pytest.raises(Exception, match="reserved"):
+                state._parse({
+                    "messages": [{"role": "user", "content": "x"}],
+                    "tenant": integrity.CANARY_TENANT,
+                })
+        finally:
+            state.pool.close()
+
+
+# ----------------------------------------------------------------------
+# Fingerprint overhead bound (the telemetry-overhead bar)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fingerprint_decode_overhead_under_1_percent():
+    """The fold is the ONLY per-step work fingerprints add to the batched
+    decode (the packed fetch adds 2 rows of int32 — bytes, not a round
+    trip). Bound it RELATIVELY, on the same backend: A/B the real
+    ``batched_decode_scan`` with ``fingerprint`` on vs off over a chunk
+    of steps on a production-PROPORTIONED model (dim ≥ 32× batch — the
+    fold reads B×vocab floats once while the step re-reads the lm head's
+    vocab×dim alone, so the structural ratio is ≤ B/dim ≈ 0.8%, before
+    counting any transformer layer). Same-device ratio: no cross-backend
+    budget games."""
+    import functools
+
+    import jax
+
+    from distributed_llama_tpu.engine.weights import random_params_on_device
+    from distributed_llama_tpu.models import llama
+    from distributed_llama_tpu.models.config import config_from_spec
+    from distributed_llama_tpu.models.sampling import batched_decode_scan
+    from tests.model_utils import tiny_spec
+
+    B, CHUNK = 4, 16
+    spec = tiny_spec(
+        dim=1024, hidden_dim=2048, n_layers=2, n_heads=8, n_kv_heads=8,
+        vocab_size=4096, seq_len=64,
+    )
+    cfg = config_from_spec(spec)
+    params = random_params_on_device(cfg, dtype=jnp.float32, seed=0, layered=True)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def run(fingerprint, cache, keys):
+        return batched_decode_scan(
+            cfg, params, jnp.ones(B, jnp.int32), cache,
+            jnp.zeros(B, jnp.int32), jnp.ones(B, bool), keys, CHUNK,
+            jnp.zeros(B, jnp.float32), jnp.full(B, 0.9, jnp.float32),
+            fingerprint=fingerprint,
+        )
+
+    def timed(fingerprint):
+        samples = []
+        for rep in range(4):
+            cache = llama.init_batch_cache(cfg, B, dtype=jnp.float32)
+            keys = jnp.stack([jax.random.PRNGKey(i) for i in range(B)])
+            t0 = time.perf_counter()
+            out = run(fingerprint, cache, keys)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            if rep > 0:  # rep 0 is the compile
+                samples.append(dt)
+        return sorted(samples)[len(samples) // 2]
+
+    base = timed(False)
+    with_fp = timed(True)
+    overhead = max(0.0, with_fp - base) / base
+    assert overhead < 0.01, (
+        f"fingerprint fold adds {overhead * 100:.2f}% to a [B={B}, "
+        f"chunk={CHUNK}] batched decode chunk (clean {base * 1e3:.1f} ms, "
+        f"fingerprinted {with_fp * 1e3:.1f} ms); the bar is 1%"
+    )
