@@ -99,6 +99,33 @@ def _page_bucket(n: int) -> int:
     return next_pow2(n)
 
 
+@jax.jit
+def _slice_page(pool, pid):
+    """One pool page's bytes across every layer/half as a flat list (the
+    spill-entry layout of kv_cache.download_pool_page) — ONE compiled
+    program + ONE host transfer per spill instead of 2·layers separate
+    fetches (the download runs under the scheduler cond; its wall time is
+    lock hold time for every lane)."""
+    out = []
+    for pk, pv in pool:
+        out.extend(kvc.slice_pool_page(pk, pid))
+        out.extend(kvc.slice_pool_page(pv, pid))
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _upload_page(pool, pid, page_kvs):
+    """Write one spilled page's host byte arrays into pool page ``pid``
+    across every layer — the spill-tier reload, :func:`_publish_pages` in
+    reverse (ISSUE 11). ``page_kvs`` is per layer a pair of flat
+    array lists (``[data]``, or ``[data, scales]`` for i8 — the
+    download's verbatim layout). The donated pool aliases in place."""
+    return [
+        (kvc.upload_pool_page(pk, pid, hk), kvc.upload_pool_page(pv, pid, hv))
+        for (pk, pv), (hk, hv) in zip(pool, page_kvs)
+    ]
+
+
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _publish_pages(page: int, slab, pool, page_ids, src_page, row):
     """Copy slab row ``row``'s page slots ``src_page`` into pool pages
@@ -518,6 +545,11 @@ class BatchScheduler:
         spec_draft: int = 0,
         spec_ngram: int = 3,
         replica_id: int = 0,
+        host_spill_bytes: int = 0,
+        spill_dir: str | None = None,
+        spill_disk_bytes: int = 0,
+        spill_arena=None,
+        shared_index=None,
     ):
         tp_engine = engine._tp_engine
         if tp_engine is not None and not hasattr(tp_engine, "batched_decode_chunk"):
@@ -578,11 +610,43 @@ class BatchScheduler:
                     )
                 from distributed_llama_tpu.engine.prefix_cache import PrefixCache
 
+                # host-RAM spill tier (ISSUE 11, engine/spill.py): evicted
+                # pages' bytes land in a bounded arena (shared across a
+                # replica pool when the serving layer passes one) and
+                # reload on a later match — re-upload ≪ re-prefill.
+                # Single-chip pools only for now: the sharded tp pool's
+                # per-shard download/upload programs are the known
+                # follow-up, and spill must never take the cache down
+                arena = spill_arena
+                if arena is None and host_spill_bytes > 0:
+                    import os as _os
+
+                    from distributed_llama_tpu.engine.spill import HostArena
+
+                    arena = HostArena(
+                        int(host_spill_bytes),
+                        disk_path=(
+                            _os.path.join(spill_dir, "dllama-kv-spill.bin")
+                            if spill_dir and spill_disk_bytes > 0 else None
+                        ),
+                        disk_budget_bytes=int(spill_disk_bytes),
+                    )
+                if arena is not None and tp_engine is not None:
+                    print(
+                        "⚠️ host-RAM spill disabled: the sharded tp page "
+                        "pool has no download/upload programs yet "
+                        "(single-chip backend only)"
+                    )
+                    arena = None
                 self._prefix = PrefixCache(
                     kv_pages, page_size,
                     page_bytes=llama.page_pool_bytes(
                         engine.cfg, page_size, engine.cache_dtype
                     ),
+                    spill=arena,
+                    page_fetch=self._download_page if arena is not None else None,
+                    owner_id=replica_id,
+                    shared_index=shared_index,
                 )
                 if tp_engine is None:
                     self._pool = llama.init_page_pool(
@@ -965,17 +1029,85 @@ class BatchScheduler:
     # republish only manifests as a LATER device program).
     # ------------------------------------------------------------------
 
+    def _download_page(self, pid: int) -> list[np.ndarray]:
+        """Host byte arrays of pool page ``pid`` across every layer and
+        half, in the flat spill-entry layout (the PrefixCache eviction
+        hook). One fused slice program + one pytree transfer: the read
+        dispatches before any later publish can recycle the page id
+        (device ordering keeps it exact), and the single blocking
+        device_get bounds the scheduler-cond hold time per spill."""
+        return list(jax.device_get(_slice_page(self._pool, jnp.int32(pid))))
+
+    def _page_pytree(self, arrays: list) -> list:
+        """Regroup a flat spill entry back into the per-layer (k, v)
+        array-list pairs :func:`_upload_page` consumes. Raises on a layout
+        mismatch (a spill entry from an incompatible config must fall
+        back to a cold prefill, never upload misshapen bytes)."""
+        halves: list[list] = []
+        i = 0
+        for pk, pv in self._pool:
+            for half in (pk, pv):
+                n = kvc.pool_page_arrays_per_half(half)
+                halves.append(list(arrays[i : i + n]))
+                i += n
+        if i != len(arrays):
+            raise ValueError(
+                f"spill entry layout mismatch: {len(arrays)} arrays, "
+                f"expected {i}"
+            )
+        return [(halves[2 * l], halves[2 * l + 1]) for l in range(len(self._pool))]
+
+    def _reload_spilled_locked(self, tokens: np.ndarray) -> int:
+        """Pull spilled pages of this prompt's prefix back into the pool
+        BEFORE the radix match (cond held): the match then binds the
+        reloaded chain zero-copy exactly like always-resident pages. The
+        ``engine.spill`` chaos site fires per candidate block (``row=``
+        selects the REPLICA id, like engine.sdc): a raise aborts the
+        reload — already-uploaded blocks stay, deeper blocks prefill cold
+        — and ``kind=corrupt`` flips arena bytes in place so the CRC gate
+        must catch them (stale KV is never served)."""
+        prefix = self._prefix
+
+        def pre(chain_key):
+            rule = self._faults.fires("engine.spill", row=self.replica_id)
+            if rule is None:
+                return
+            if rule.kind == "corrupt":
+                # silent in-arena corruption (a host RAM / disk bit flip):
+                # nothing raises here — the reload's CRC verification is
+                # the only thing standing between this and served-wrong-KV
+                prefix.spill_corrupt(chain_key)
+            else:
+                raise faults.InjectedFault(
+                    rule.message or "injected fault at engine.spill"
+                )
+
+        def upload(pid, arrays):
+            with self.engine._tel.span("prefix_spill_reload", page=int(pid)):
+                self._pool = _upload_page(
+                    self._pool, jnp.int32(pid), self._page_pytree(arrays)
+                )
+
+        return prefix.reload(tokens, upload, pre=pre)
+
     def _match_alias(self, stream: BatchStream, tokens: np.ndarray) -> list:
         """Walk the radix tree for the prompt's longest published prefix
         and bind it to the row ZERO-COPY: the row records the chain's page
         ids as its page table and advances its position past the matched
         tokens — no bytes move; the suffix prefill's (and every later
         step's) attention reads the pages through the table. The chain's
-        refs stay held for the row's lifetime."""
+        refs stay held for the row's lifetime. With a spill arena, pages
+        of this prefix that were evicted to host RAM (by this replica or
+        a peer) are re-uploaded first, so the match sees the full
+        reloadable chain."""
         prefix = self._prefix
         with self._cond:
             # unwind any stale alias left by a caller that skipped reset
             self._release_pins_locked(stream)
+            if prefix.spill is not None and not self._lost:
+                # a dead replica must not re-announce chains to the shared
+                # index after the pool dropped its ownership
+                self._reload_spilled_locked(tokens)
             chain = prefix.match(tokens)
             if not chain:
                 return []
@@ -995,6 +1127,13 @@ class BatchScheduler:
         prefix = self._prefix
         page = prefix.page
         with self._cond:
+            if self._lost:
+                # the replica died between the last suffix chunk and here:
+                # a publish now would re-announce chains to the shared
+                # index AFTER the pool dropped this replica's ownership
+                # (dangling routing); the request's own ReplicaLost
+                # surfaces at its next chunk boundary
+                return
             new_ids, new_blocks = prefix.publish(tokens, tokens.shape[0], chain)
             if new_ids:
                 bucket = _page_bucket(len(new_ids))

@@ -47,6 +47,16 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     by integrity checks (engine/integrity.py canaries /
                     fingerprints / shadow votes). ``row=`` selects the
                     REPLICA id, like the replica.* sites
+``engine.spill``    spill-tier reload fault (ISSUE 11): fired per
+                    candidate block while an admission match pulls
+                    spilled prefix pages back from the host-RAM arena
+                    (engine/spill.py). A raise aborts the reload —
+                    already-uploaded blocks stay, deeper blocks fall
+                    back to a COLD prefill; ``kind=corrupt`` flips the
+                    arena entry's bytes in place (a silent host-RAM/disk
+                    bit flip), which the per-entry CRC verification must
+                    catch and drop — stale KV is never uploaded, the
+                    block prefills cold. ``row=`` selects the REPLICA id
 ``engine.preempt``  raise during a priority preemption's eviction
                     (engine/batch.py ``preempt_below``): the victim row is
                     QUARANTINED instead of cleanly requeued — its request
@@ -196,6 +206,7 @@ SITES = (
     "engine.paged_attn",
     "engine.preempt",
     "engine.sdc",
+    "engine.spill",
     "replica.crash",
     "replica.hang",
     "replica.slow",

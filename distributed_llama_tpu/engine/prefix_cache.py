@@ -41,16 +41,119 @@ Design
   fine at the default budgets (hundreds of pages, tens of µs under the
   scheduler lock); a last_use-ordered leaf index is the known follow-up
   if ``--kv-pages`` grows to the tens of thousands.
+* **Tiered capacity below HBM** (ISSUE 11, engine/spill.py): with a
+  :class:`~distributed_llama_tpu.engine.spill.HostArena` attached,
+  eviction no longer discards the page — its bytes (data+scales verbatim
+  for i8) spill to bounded host RAM (and optionally an mmap'd disk file,
+  echoing the reference's disc-backed KV), and a later admission match
+  that runs out of device-resident chain RELOADS the spilled pages
+  (:meth:`reload` — the publish machinery in reverse: alloc a pool page,
+  upload the host bytes, re-insert the node). Re-upload is orders of
+  magnitude cheaper than re-prefill, so effective cacheable-prefix
+  capacity at fixed ``--kv-pages`` multiplies. Every spilled entry is
+  CRC-verified on reload; a mismatch (host RAM/disk corrupted it) drops
+  the entry and the block prefills cold — stale KV is never served.
+* **Cross-replica sharing** (:class:`SharedPrefixIndex`): each replica's
+  tree reports its published/evicted chains to one shared host-side
+  index; the replica pool routes a request to the replica owning the
+  LONGEST matched chain (server/replicas.py ``place``), so the Zipf head
+  of a chat workload is prefilled once GLOBALLY instead of once per
+  replica. The arena is shared too: a chain spilled by replica A reloads
+  into replica B's pool by copy (A's entry stays), which is how hot head
+  nodes replicate across pools when routing alone cannot keep up. A
+  replica death atomically drops its chains from the index (and its
+  arena entries — a silently-corrupt replica's spills are suspect).
 
 Thread model: the owning :class:`~distributed_llama_tpu.engine.batch.
 BatchScheduler` calls every method under its condition lock; the tree
 itself is lock-free on purpose (one lock, one owner — no ordering hazards
-between tree state and slab/pool dispatches).
+between tree state and slab/pool dispatches). The shared index and the
+arena have their own LEAF locks (multiple schedulers and the replica
+pool reach them concurrently); neither ever calls back out.
 """
 
 from __future__ import annotations
 
+import threading
+
 from distributed_llama_tpu import telemetry
+from distributed_llama_tpu.engine.spill import SpillCorrupt
+
+
+class SharedPrefixIndex:
+    """Host-side map ``token-prefix chain -> owning replicas`` over the
+    per-replica radix trees (the routing half of the global cache tier).
+
+    Each :class:`PrefixCache` reports node inserts (publish/reload) and
+    removals (evict/unpublish) here; :meth:`match` answers "which replica
+    owns the longest published chain of this prompt" for placement.
+    Per-owner chains stay contiguous from the root by construction (the
+    trees publish contiguous chains and evict leaf-first), and the match
+    walk enforces contiguity anyway (an owner absent at block i is
+    ignored at every deeper block)."""
+
+    def __init__(self, page: int):
+        self.page = int(page)
+        self._lock = threading.Lock()
+        self._owners: dict[tuple, set[int]] = {}
+
+    def publish(self, owner: int, chain: tuple) -> None:
+        with self._lock:
+            self._owners.setdefault(tuple(chain), set()).add(int(owner))
+
+    def withdraw(self, owner: int, chain: tuple) -> None:
+        with self._lock:
+            owners = self._owners.get(tuple(chain))
+            if owners is not None:
+                owners.discard(int(owner))
+                if not owners:
+                    del self._owners[tuple(chain)]
+
+    def drop_owner(self, owner: int) -> None:
+        """A replica died: every chain it owned leaves the index in one
+        locked pass — placement must never route to a dead replica's
+        pages (the no-dangling-routing contract)."""
+        owner = int(owner)
+        with self._lock:
+            for chain in [c for c, o in self._owners.items() if owner in o]:
+                self._owners[chain].discard(owner)
+                if not self._owners[chain]:
+                    del self._owners[chain]
+
+    def match(self, tokens) -> dict[int, int]:
+        """Per-replica depth of the longest contiguous owned chain of
+        ``tokens`` (full blocks strictly shorter than the prompt, the
+        tree-match bound): ``{replica: n_blocks}``, empty on no match."""
+        page = self.page
+        max_blocks = (len(tokens) - 1) // page
+        # one int-conversion pass OUTSIDE the lock, keys grown
+        # incrementally: the cumulative-prefix keys still hash O(depth)
+        # each (flat-dict tradeoff), but nothing re-walks the prompt per
+        # block while holding the lock every publish/evict also takes
+        ids = [int(t) for t in tokens[: max_blocks * page]]
+        depths: dict[int, int] = {}
+        alive: set[int] | None = None
+        key: tuple = ()
+        with self._lock:
+            for i in range(max_blocks):
+                key = key + tuple(ids[i * page : (i + 1) * page])
+                owners = self._owners.get(key)
+                if not owners:
+                    break
+                alive = set(owners) if alive is None else alive & owners
+                if not alive:
+                    break
+                for o in alive:
+                    depths[o] = i + 1
+        return depths
+
+    def owners(self, chain: tuple) -> set[int]:
+        with self._lock:
+            return set(self._owners.get(tuple(chain), set()))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._owners)
 
 
 class PageNode:
@@ -70,13 +173,28 @@ class PageNode:
 class PrefixCache:
     """Host-side index of the device page pool (see module docstring)."""
 
-    def __init__(self, n_pages: int, page: int, page_bytes: int = 0):
+    def __init__(
+        self, n_pages: int, page: int, page_bytes: int = 0,
+        spill=None, page_fetch=None, owner_id: int = 0, shared_index=None,
+    ):
         if n_pages < 1:
             raise ValueError(f"need at least one pool page, got {n_pages}")
         if page < 1:
             raise ValueError(f"page size must be positive, got {page}")
         self.page = page
         self.capacity = n_pages
+        # tiered capacity + cross-replica sharing (ISSUE 11): ``spill`` is
+        # the shared HostArena (engine/spill.py), ``page_fetch(page_id)``
+        # the owning scheduler's device→host download of one pool page's
+        # byte arrays (the spill side; the upload side is a reload()
+        # argument — both device programs belong to the scheduler),
+        # ``shared_index`` the pool-wide SharedPrefixIndex this tree
+        # reports its chains to, ``owner_id`` this replica's identity in
+        # both. All optional: a bare PrefixCache keeps the PR 4 contract.
+        self.spill = spill
+        self.page_fetch = page_fetch
+        self.owner_id = int(owner_id)
+        self.shared_index = shared_index
         # logical KV bytes per page across all layers/halves
         # (llama.page_pool_bytes) — feeds the bytes gauge and the
         # copy-traffic-saved counter; 0 = unknown (host-only unit tests)
@@ -124,6 +242,36 @@ class PrefixCache:
             node = stack.pop()
             yield node
             stack.extend(node.children.values())
+
+    @staticmethod
+    def chain_key(node: PageNode) -> tuple:
+        """Full token-prefix tuple ending at ``node``'s block (root→node
+        key concatenation) — the spill-arena / shared-index key: KV bytes
+        are exact only for the identical whole prefix."""
+        keys = []
+        while node is not None and node.key is not None:
+            keys.append(node.key)
+            node = node.parent
+        out: list[int] = []
+        for k in reversed(keys):
+            out.extend(int(t) for t in k)
+        return tuple(out)
+
+    def walk(self, tokens) -> list[PageNode]:
+        """The :meth:`match` walk WITHOUT refs, counters or clock ticks —
+        the reload path peeks at where the device-resident chain ends
+        before deciding what to pull back from the spill arena."""
+        page = self.page
+        max_blocks = (len(tokens) - 1) // page
+        chain: list[PageNode] = []
+        node = self.root
+        for i in range(max_blocks):
+            child = node.children.get(tuple(tokens[i * page : (i + 1) * page]))
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        return chain
 
     def _set_pages_gauges(self) -> None:
         used = self.pages_in_use()
@@ -176,6 +324,21 @@ class PrefixCache:
                     f"page {pid} unpinned while a live row aliases it "
                     "(eviction could recycle it mid-read)"
                 )
+        if self.spill is not None:
+            # spill-tier exclusivity (ISSUE 11): only EVICTED pages live in
+            # the arena. A pinned (row-aliased or publish-held) page that
+            # also had an arena entry under this owner would mean eviction
+            # spilled a live page, or a reload forgot to retire its source
+            # entry — either way two copies of "the" bytes with no single
+            # owner of truth
+            for node in seen.values():
+                if node.refs > 0:
+                    key = self.chain_key(node)
+                    assert not self.spill.has(self.owner_id, key), (
+                        f"pinned page {node.page_id} is simultaneously "
+                        "resident in the spill arena (chain of "
+                        f"{len(key)} tokens)"
+                    )
 
     # ------------------------------------------------------------------
     # Match / release (admission)
@@ -194,15 +357,7 @@ class PrefixCache:
         :meth:`release`\\ s the chain at row reset/quarantine — not after
         admission."""
         page = self.page
-        max_blocks = (len(tokens) - 1) // page
-        chain: list[PageNode] = []
-        node = self.root
-        for i in range(max_blocks):
-            child = node.children.get(tuple(tokens[i * page : (i + 1) * page]))
-            if child is None:
-                break
-            chain.append(child)
-            node = child
+        chain = self.walk(tokens)
         t = self._tick()
         for nd in chain:
             self._ref(nd)
@@ -264,6 +419,7 @@ class PrefixCache:
                     node.children[key] = child
                     new_ids.append(pid)
                     new_blocks.append(i)
+                    self._note_insert(child)
                 self._ref(child)
                 pinned.append(child)
                 child.last_use = t
@@ -300,6 +456,11 @@ class PrefixCache:
             nd = stack.pop()
             if nd.refs > 0:
                 self._pinned -= 1
+            if self.shared_index is not None:
+                # the publish already announced these chains; an unwound
+                # publish must retract them or placement routes to pages
+                # that were never written
+                self.shared_index.withdraw(self.owner_id, self.chain_key(nd))
             stack.extend(nd.children.values())
         self.free.extend(new_ids)
         self._set_pages_gauges()
@@ -319,7 +480,11 @@ class PrefixCache:
     def _evict_one(self) -> bool:
         """Reclaim the least-recently-used unreferenced LEAF (children keep
         their ancestors alive: evicting an interior page would strand the
-        chain below it). Returns False when every leaf is pinned."""
+        chain below it). Returns False when every leaf is pinned. With a
+        spill arena attached the page's bytes are downloaded and spilled
+        BEFORE the page id is freed (the download dispatches against the
+        pre-recycle pool contents; device ordering keeps it exact even
+        though a later publish may reuse the id immediately)."""
         victim: PageNode | None = None
         for node in self._walk():
             if node.children or node.refs > 0:
@@ -328,8 +493,156 @@ class PrefixCache:
                 victim = node
         if victim is None:
             return False
+        key = None
+        if self.spill is not None or self.shared_index is not None:
+            key = self.chain_key(victim)
+        if self.spill is not None and self.page_fetch is not None:
+            try:
+                self.spill.put(self.owner_id, key, self.page_fetch(victim.page_id))
+                self.tel.spill_pages.inc()
+            except Exception as e:
+                # spilling is an optimization: a failed download degrades
+                # to the PR 4 behavior (the page simply vanishes)
+                print(f"⚠️ page spill failed; evicting without it: {e}")
+            self._set_spill_gauges()
+        if self.shared_index is not None:
+            self.shared_index.withdraw(self.owner_id, key)
         del victim.parent.children[victim.key]
         self.free.append(victim.page_id)
         self.tel.evictions.inc()
         self._set_pages_gauges()
         return True
+
+    # ------------------------------------------------------------------
+    # Spill tier (ISSUE 11, engine/spill.py): reload = publish in reverse
+    # ------------------------------------------------------------------
+
+    def _note_insert(self, node: PageNode) -> None:
+        """A node entered the tree (publish or reload): announce the chain
+        to the shared index, and retire any own arena entry — the fresh
+        device copy supersedes it (the exclusivity invariant check()
+        asserts)."""
+        if self.spill is None and self.shared_index is None:
+            return
+        key = self.chain_key(node)
+        if self.spill is not None:
+            self.spill.drop(self.owner_id, key)
+            self._set_spill_gauges()
+        if self.shared_index is not None:
+            self.shared_index.publish(self.owner_id, key)
+
+    def _set_spill_gauges(self) -> None:
+        self.tel.spill_resident_pages.set(self.spill.depth())
+        self.tel.spill_bytes.set(self.spill.resident_bytes)
+
+    def spill_depth(self) -> int:
+        """Arena entries owned by this replica (the /readyz read)."""
+        return 0 if self.spill is None else self.spill.depth(self.owner_id)
+
+    def spill_take(self, chain: tuple):
+        """One reload read: the owner's own entry MOVES back out of the
+        arena; another replica's entry is COPIED (cross-replica sharing —
+        the spiller keeps serving other readers). A CRC mismatch drops
+        the corrupt entry and counts it, then the PEER lookup still runs
+        — a bit flip in one replica's copy must not defeat the redundancy
+        the shared arena exists for; only when no intact copy survives
+        anywhere does the read miss (cold prefill, never stale KV)."""
+        if self.spill is None:
+            return None
+        arrays = None
+        try:
+            arrays = self.spill.take(self.owner_id, chain)
+        except SpillCorrupt:
+            pass  # own copy corrupt + dropped (counted); try the peers
+        if arrays is None:
+            arrays = self.spill.peek_shared(chain, exclude_owner=self.owner_id)
+        self._set_spill_gauges()
+        return arrays
+
+    def spill_corrupt(self, chain: tuple) -> None:
+        """Chaos hook (``engine.spill`` ``kind=corrupt``): flip bytes of
+        the resident entries for ``chain`` in place."""
+        if self.spill is not None:
+            self.spill.corrupt(chain)
+
+    def reload(self, tokens, upload, pre=None) -> int:
+        """Extend the device-resident chain of ``tokens`` from the spill
+        arena — the :meth:`publish` machinery in reverse: per missing
+        block (deepest-first from where :meth:`walk` ends, bounded like
+        match at full blocks strictly shorter than the prompt) take the
+        spilled bytes, allocate a pool page (may itself evict+spill), run
+        the caller's ``upload(page_id, arrays)`` device copy, and insert
+        the node. ``pre(chain_key)`` is the scheduler's ``engine.spill``
+        chaos hook. ANY failure — arena miss, CRC drop, allocation dry,
+        an upload raise, an injected fault — stops the reload cleanly:
+        blocks already uploaded stay (they hold verified bytes), deeper
+        blocks fall back to the cold prefill, pins taken for the walk are
+        released. Returns the number of pages reloaded."""
+        if self.spill is None:
+            return 0
+        page = self.page
+        max_blocks = (len(tokens) - 1) // page
+        nodes = self.walk(tokens)
+        if len(nodes) >= max_blocks:
+            return 0
+        node = nodes[-1] if nodes else self.root
+        # pin the growing chain exactly like publish: a mid-reload _alloc
+        # may evict, and the evictor must never detach the chain being
+        # rebuilt (or the just-walked parents)
+        pinned: list[PageNode] = list(nodes)
+        for nd in pinned:
+            self._ref(nd)
+        n_reloaded = 0
+        try:
+            for i in range(len(nodes), max_blocks):
+                chain = tuple(int(t) for t in tokens[: (i + 1) * page])
+                try:
+                    if pre is not None:
+                        pre(chain)
+                    # alloc BEFORE taking the entry: spill_take MOVES the
+                    # owner's bytes out of the arena, so an allocation
+                    # failure after it would permanently lose them — and
+                    # a dry pool is likeliest exactly under the pinned
+                    # pressure the spill tier exists for. The chain being
+                    # reloaded is not in the tree, so the eviction _alloc
+                    # may trigger cannot touch it.
+                    pid = self._alloc()
+                    if pid is None:
+                        break  # everything pinned: no room to reload into
+                    arrays = self.spill_take(chain)
+                    if arrays is None:
+                        self.free.append(pid)
+                        break
+                    try:
+                        upload(pid, arrays)
+                    except Exception:
+                        self.free.append(pid)
+                        # only the upload failed — the bytes themselves
+                        # are verified-good: restore the entry so a later
+                        # match can retry instead of cold-prefilling the
+                        # chain forever
+                        self.spill.put(self.owner_id, chain, arrays)
+                        raise
+                except Exception as e:
+                    # an injected engine.spill raise or a failed upload
+                    # dispatch: the remaining blocks prefill cold
+                    # (interpreter exits are not Exception and propagate)
+                    print(f"⚠️ spill reload aborted; prefilling cold: {e}")
+                    break
+                key = tuple(tokens[i * page : (i + 1) * page])
+                child = PageNode(key, pid, node)
+                node.children[key] = child
+                child.last_use = self._tick()
+                self._note_insert(child)
+                self._ref(child)
+                pinned.append(child)
+                node = child
+                n_reloaded += 1
+                self.tel.spill_reloads.inc()
+        finally:
+            for nd in pinned:
+                self._unref(nd)
+        if n_reloaded:
+            self._set_pages_gauges()
+            self._set_pinned_gauge()
+        return n_reloaded

@@ -85,6 +85,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument(
+        "--kv-pages", type=int, default=None,
+        help="self-host prefix-cache pool budget in pages (default: "
+        "slab-sized). A deliberately tiny value forces eviction → "
+        "host-RAM spill → reload, the ISSUE 11 capacity-ladder smoke",
+    )
+    p.add_argument(
+        "--host-spill-mb", type=float, default=16.0,
+        help="self-host host-RAM spill arena budget in MiB "
+        "(--host-spill-mb on the server; 0 disables the tier)",
+    )
+    p.add_argument(
         "--server-tenants", type=str, default=None,
         help="self-host --tenants spec (weights/priorities/queues); "
         "defaults to the workload tenants at weight 1",
@@ -188,6 +199,8 @@ def main(argv=None) -> int:
             preempt=not args.no_preempt,
             faults_spec=args.faults,
             faults_seed=args.faults_seed,
+            kv_pages=args.kv_pages,
+            host_spill_mb=args.host_spill_mb,
             admission_queue=args.admission_queue,
             replicas=args.replicas,
             canary_interval_s=args.canary_interval_s,

@@ -48,8 +48,22 @@ SERVER_COUNTERS = (
     "dllama_deadline_exceeded_total",
     "dllama_tenant_admitted_total",
     "dllama_tenant_rejected_total",
+    # prefix-cache counter family (ISSUE 11): device-tier hit/miss/evict
+    # plus the spill ladder and the cross-replica routing hits — a tiered-
+    # cache chaos or capacity run gates on these (--expect-delta /
+    # --expect-zero)
     "dllama_prefix_cache_hits_total",
     "dllama_prefix_cache_misses_total",
+    "dllama_prefix_cache_evictions_total",
+    "dllama_prefix_spill_pages_total",
+    "dllama_prefix_spill_reloads_total",
+    "dllama_prefix_spill_dropped_total",
+    "dllama_prefix_shared_hits_total",
+    # hit DEPTH, not just hit count: prompt tokens actually served from
+    # cached pages over the window (the histogram's _sum series). The
+    # hit/miss ratio alone can't see eviction damage when every prompt
+    # shares a template-preamble block — this can
+    "dllama_prefix_cache_matched_tokens_sum",
     "dllama_faults_injected_total",
     "dllama_watchdog_stalls_total",
     # replica-loss fault tolerance (ISSUE 9): the failover/replay ledger —

@@ -57,6 +57,8 @@ def start_selfhost(
     faults_seed: int = 0,
     decode_chunk: int = 4,
     kv_page_size: int = 16,
+    kv_pages: int | None = None,
+    host_spill_mb: float = 16.0,
     admission_queue: int | None = None,
     deadline_ms: float | None = None,
     seed: int = 0,
@@ -100,7 +102,11 @@ def start_selfhost(
         temperature=0.0, topp=0.9, seed=1, chat_template=None,
         parallel=parallel, batch_decode=True, decode="device",
         decode_chunk=decode_chunk, prefill_chunk=64,
-        prefix_cache=True, kv_pages=None, kv_page_size=kv_page_size,
+        # tiered prefix cache (ISSUE 11): kv_pages deliberately tiny in
+        # the spill smoke (forces eviction → host-RAM spill → reload);
+        # None keeps the slab-sized default
+        prefix_cache=True, kv_pages=kv_pages, kv_page_size=kv_page_size,
+        host_spill_mb=host_spill_mb, spill_disk_dir=None, spill_disk_mb=0,
         tenants=tenants, preempt=preempt,
         admission_queue=admission_queue, deadline_ms=deadline_ms,
         stall_timeout_s=60.0,

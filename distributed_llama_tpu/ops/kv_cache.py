@@ -32,6 +32,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 I8_SENTINELS = ("i8", "int8")
 
@@ -327,6 +328,46 @@ def virtual_rows_batched(half_b, pool_half, tables, matched):
     pooled = gather_pool_pages(pool_half, tables)[:, :S]
     sel = jnp.arange(S)[None, :] < matched[:, None]
     return select_kv(sel, pooled, half_b)
+
+
+def slice_pool_page(pool_half, pid) -> list:
+    """Pool page ``pid`` as a FLAT slice list — the spill-entry layout
+    (engine/spill.py): ``[data]`` for plain halves, ``[data, scales]``
+    for i8 ``QuantizedKV``. Traceable (``pid`` may be a tracer), so the
+    scheduler fuses every layer's slices into ONE download program; the
+    flat layout lets the arena checksum and byte-account without knowing
+    the dtype."""
+    if isinstance(pool_half, QuantizedKV):
+        return [pool_half.data[pid], pool_half.scales[pid]]
+    return [pool_half[pid]]
+
+
+def download_pool_page(pool_half, pid: int) -> list[np.ndarray]:
+    """Host byte arrays of pool page ``pid`` — the unfused (per-half)
+    spill download, verbatim bytes (the reload byte-parity contract).
+    Blocking (np.asarray): tests and tools; the scheduler's production
+    path fuses :func:`slice_pool_page` across layers instead."""
+    return [np.asarray(a) for a in slice_pool_page(pool_half, pid)]
+
+
+def upload_pool_page(pool_half, pid, arrays: list):
+    """Write one downloaded page's arrays back into pool page ``pid`` —
+    the spill-tier reload (publish in reverse). Inverse of
+    :func:`download_pool_page`'s flat layout; traced under jit (``pid``
+    may be a tracer), callers donate the pool."""
+    if isinstance(pool_half, QuantizedKV):
+        return QuantizedKV(
+            pool_half.data.at[pid].set(arrays[0]),
+            pool_half.scales.at[pid].set(arrays[1]),
+        )
+    return pool_half.at[pid].set(arrays[0])
+
+
+def pool_page_arrays_per_half(pool_half) -> int:
+    """How many flat arrays :func:`download_pool_page` yields for this
+    half (2 for i8 data+scales, 1 otherwise) — the spill entry's layout
+    contract."""
+    return 2 if isinstance(pool_half, QuantizedKV) else 1
 
 
 def publish_row_pages(pool_half, slab_half, row, src_page, page_ids, page: int):

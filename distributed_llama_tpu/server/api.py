@@ -48,6 +48,7 @@ import argparse
 import dataclasses
 import json
 import math
+import os
 import random
 import signal
 import threading
@@ -222,6 +223,38 @@ class ApiState:
             # failure domain being supervised
             and (n > 1 or n_replicas > 1)
         )
+        # global prefix-cache tier (ISSUE 11): one shared radix index over
+        # every replica's tree (placement routes to the owner of the
+        # longest published chain) and one pool-wide host-RAM spill arena
+        # (evicted pages reload instead of re-prefilling; an optional
+        # mmap'd disk tier sits below it, echoing the reference's
+        # disc-backed KV). Built BEFORE any replica so replica 0's
+        # scheduler wires into them too.
+        self._shared_index = None
+        self._spill_arena = None
+        if self._batch_wanted and getattr(args, "prefix_cache", True):
+            page_sz = getattr(args, "kv_page_size", 64)
+            if n_replicas > 1 and page_sz and page_sz >= 1:
+                from distributed_llama_tpu.engine.prefix_cache import (
+                    SharedPrefixIndex,
+                )
+
+                self._shared_index = SharedPrefixIndex(page_sz)
+            spill_mb = getattr(args, "host_spill_mb", None)
+            spill_mb = 64.0 if spill_mb is None else float(spill_mb)
+            if spill_mb > 0:
+                from distributed_llama_tpu.engine.spill import HostArena
+
+                disk_dir = getattr(args, "spill_disk_dir", None)
+                disk_mb = float(getattr(args, "spill_disk_mb", 0) or 0)
+                self._spill_arena = HostArena(
+                    int(spill_mb * (1 << 20)),
+                    disk_path=(
+                        os.path.join(disk_dir, "dllama-kv-spill.bin")
+                        if disk_dir and disk_mb > 0 else None
+                    ),
+                    disk_budget_bytes=int(disk_mb * (1 << 20)),
+                )
         # replica 0 FIRST: whether the batched path exists decides whether
         # more replicas make sense — discovering that after paying N-1
         # engine builds (full weight loads) would waste minutes and HBM
@@ -284,6 +317,8 @@ class ApiState:
                 max_s=30.0,
                 jitter_s=0.5,
             ),
+            shared_index=self._shared_index,
+            spill_arena=self._spill_arena,
         )
         if self.batch is not None and getattr(args, "preempt", True):
             # priority preemption: a queued high-priority arrival may evict
@@ -373,6 +408,11 @@ class ApiState:
                 spec_draft=getattr(args, "spec_draft", 0),
                 spec_ngram=getattr(args, "spec_ngram", 3),
                 replica_id=replica_id,
+                # the global cache tier (ISSUE 11): every replica's tree
+                # reports to the one shared index and spills into the one
+                # pool-wide arena (both None when the tier is off)
+                spill_arena=self._spill_arena,
+                shared_index=self._shared_index,
             )
         except ValueError as e:  # backend without a batched path (sp/ep)
             print(f"⚠️ batch decode disabled: {e}")
@@ -545,9 +585,29 @@ class ApiState:
             target=vote, name="dllama-sdc-shadow", daemon=True
         ).start()
 
+    def _route_tokens(self, params: dict):
+        """Full-prompt token ids for shared-index placement (ISSUE 11):
+        the same template+encode the admission prefill will run, computed
+        once per request so ``place`` can rank replicas by the longest
+        chain they actually own. None when the tier is off, the request
+        opted out of the prefix cache, or nothing is published yet (the
+        re-encode costs one pass over the message history — skip it
+        until the index can possibly answer)."""
+        if (
+            self._shared_index is None
+            or len(self._shared_index) == 0
+            or params.get("cache", "on") == "off"
+        ):
+            return None
+        items = [
+            ChatItem(m["role"], m["content"]) for m in params["messages"]
+        ]
+        prompt = self.template.generate(items, append_generation_prompt=True)
+        return self.tokenizer.encode(prompt, add_bos=True)
+
     def _acquire_slot(
         self, messages: list[dict], deadline: float | None = None,
-        tenant: str = DEFAULT_TENANT, priority: int = 0,
+        tenant: str = DEFAULT_TENANT, priority: int = 0, route_tokens=None,
     ) -> StreamSlot:
         """Take a free lane through weighted-fair admission: when all are
         busy the request queues BOUNDEDLY under its own tenant (excess get
@@ -581,7 +641,7 @@ class ApiState:
         tel.tenant_admitted.labels(tenant=tenant).inc()
         tel.tenant_active.labels(tenant=tenant).inc()
         try:
-            slot = self.pool.place(messages, deadline)
+            slot = self.pool.place(messages, deadline, route_tokens=route_tokens)
         except BaseException:
             # placement raced a replica death (or the deadline): give the
             # permit back — a raised ReplicaLost re-enters the requeue
@@ -657,11 +717,13 @@ class ApiState:
             send_chunk(data)
             sent += 1
 
+        route_tokens = self._route_tokens(params)
+
         def attempt_once():
             nonlocal skip
             skip = sent  # re-runs replay (and suppress) what was delivered
             slot = self._acquire_slot(
-                params["messages"], deadline, tenant, priority
+                params["messages"], deadline, tenant, priority, route_tokens
             )
             # the slot's OWN scheduler (its replica's), not replica 0's:
             # request-end bookkeeping must land on the scheduler that
@@ -678,7 +740,8 @@ class ApiState:
                 slot.stream.tenant = tenant
                 slot.stream.priority = priority
                 return self._complete_on(
-                    slot, params, guarded_send, request_id, deadline
+                    slot, params, guarded_send, request_id, deadline,
+                    route_tokens=route_tokens,
                 )
             finally:
                 slot.stream.deadline = None
@@ -734,7 +797,7 @@ class ApiState:
 
     def _complete_on(
         self, slot: StreamSlot, params: dict, send_chunk, request_id: str,
-        deadline: float | None = None,
+        deadline: float | None = None, route_tokens=None,
     ) -> dict | None:
         engine, tokenizer = slot.stream, self.tokenizer
         stream = params["stream"]
@@ -749,9 +812,17 @@ class ApiState:
             start_pos = 0
             delta_messages = params["messages"]
 
-        items = [ChatItem(m["role"], m["content"]) for m in delta_messages]
-        prompt = self.template.generate(items, append_generation_prompt=True)
-        prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
+        if start_pos == 0 and route_tokens is not None:
+            # a fresh admission prefills the FULL prompt — exactly the
+            # template+encode _route_tokens already ran for shared-index
+            # placement; reuse it instead of tokenizing the whole message
+            # history a second time on the hot path (a continuing
+            # conversation's delta prompt differs and re-encodes below)
+            prompt_tokens = route_tokens
+        else:
+            items = [ChatItem(m["role"], m["content"]) for m in delta_messages]
+            prompt = self.template.generate(items, append_generation_prompt=True)
+            prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
         seq_len = engine.cfg.seq_len
         budget = seq_len - engine.pos
         warning = None
@@ -1476,6 +1547,32 @@ def main(argv=None) -> None:
         "smaller than one slab's worth warns (concurrent long prompts "
         "contend for pinned pages), 0 disables the prefix cache. The LRU "
         "evictor reclaims unreferenced chains beyond the budget",
+    )
+    # tiered global prefix cache (ISSUE 11, docs/SERVING.md "Cache tiers
+    # and placement"): host-RAM spill below the HBM pool, optional mmap'd
+    # disk below that; with --replicas > 1 a shared radix index routes
+    # each request to the replica owning its longest published chain
+    parser.add_argument(
+        "--host-spill-mb", type=float, default=64.0,
+        help="host-RAM budget (MiB) for the prefix-page spill arena: "
+        "evicted KV pages spill here (bytes verbatim, CRC-guarded) and "
+        "re-upload on a later match instead of re-prefilling — "
+        "cacheable-prefix capacity at fixed --kv-pages multiplies. "
+        "Shared across replicas (a chain spilled by one replica reloads "
+        "into another). 0 disables the tier (single-chip backend only; "
+        "the sharded tp pool has no spill programs yet)",
+    )
+    parser.add_argument(
+        "--spill-disk-dir", type=str, default=None,
+        help="directory for the OPTIONAL mmap'd disk tier below the "
+        "host-RAM arena (the reference's disc-backed KV, "
+        "newMmapFileBuffer): host-budget overflow demotes LRU entries "
+        "to a fixed-slot spill file instead of dropping them. Off by "
+        "default",
+    )
+    parser.add_argument(
+        "--spill-disk-mb", type=float, default=256.0,
+        help="disk-tier budget (MiB) for --spill-disk-dir",
     )
     parser.add_argument(
         "--kv-page-size", type=int, default=64,

@@ -125,9 +125,20 @@ class ReplicaPool:
         place_timeout_s: float = 5.0,
         restart_policy: retry.BackoffPolicy | None = None,
         restart_seed: int | None = None,
+        shared_index=None,
+        spill_arena=None,
     ):
         from distributed_llama_tpu import telemetry
 
+        # global prefix-cache tier (ISSUE 11): the shared radix index the
+        # replicas' trees report their chains to (placement routes to the
+        # owner of the longest matched chain) and the pool-wide host-RAM
+        # spill arena. A replica death drops its entries from BOTH — no
+        # dangling routing, and a silently-corrupt replica's spilled
+        # bytes never reload anywhere.
+        self.shared_index = shared_index
+        self.spill_arena = spill_arena
+        self.shared_hits_total = 0
         self.build_replica = build_replica
         self.replicas: list[Replica] = list(replicas)
         self.admission = admission
@@ -227,25 +238,56 @@ class ReplicaPool:
         serving layer iterate busy flags / streams through this)."""
         return [s for r in self.replicas for s in r.slots]
 
-    def place(self, messages, deadline: float | None = None):
+    def place(self, messages, deadline: float | None = None, route_tokens=None):
         """Claim a free slot for an admitted request: best chat-prefix
-        affinity first, then the least-loaded replica, preferring an empty
-        chat cache on ties (the pre-pool slot scheduler's contract, now
-        replica-aware). Healthy replicas only while any has room; suspect
-        ones are the fallback; dead ones never place. When nothing is
-        placeable — a replica died between the admission grant and here —
-        waits briefly (bounded by ``place_timeout_s`` and the request
-        ``deadline``) and then raises :class:`faults.ReplicaLost`, which
-        the serving layer's requeue loop converts into a fresh pass
-        through fair admission."""
+        affinity first (a continuing conversation resumes its own slot's
+        KV), then the replica the SHARED RADIX INDEX says owns the
+        longest published chain of this prompt (``route_tokens`` — the
+        cross-replica prefix routing of ISSUE 11: the Zipf head prefills
+        once globally instead of once per replica), then the least-loaded
+        replica, preferring an empty chat cache on ties. Healthy replicas
+        only while any has room; suspect ones are the fallback; dead ones
+        never place — and a dead replica's chains left the index with it,
+        so routing never dangles. When nothing is placeable — a replica
+        died between the admission grant and here — waits briefly
+        (bounded by ``place_timeout_s`` and the request ``deadline``) and
+        then raises :class:`faults.ReplicaLost`, which the serving
+        layer's requeue loop converts into a fresh pass through fair
+        admission."""
+        shared: dict[int, int] = {}
+        if self.shared_index is not None and route_tokens is not None:
+            shared = self.shared_index.match(route_tokens)
         limit = time.monotonic() + self.place_timeout_s
         if deadline is not None:
             limit = min(limit, deadline)
         with self._cond:
             while True:
-                slot = self._pick_slot_locked(messages)
-                if slot is not None:
+                picked = self._pick_slot_locked(messages, shared)
+                if picked is not None:
+                    rep, slot = picked
                     slot.busy = True
+                    depth = shared.get(rep.idx, 0)
+                    best_other = max(
+                        (d for o, d in shared.items() if o != rep.idx),
+                        default=0,
+                    )
+                    if (
+                        depth > 0
+                        and depth > best_other
+                        and slot.cache.match_len(messages) == 0
+                    ):
+                        # the index actually DECIDED this placement: the
+                        # picked replica owns strictly more of the chain
+                        # than any alternative, and chat-slot affinity
+                        # (the dominant sort key) didn't choose it first.
+                        # Counting mere ownership overlap — e.g. a fully
+                        # replicated Zipf head, where least-loaded decides
+                        # — would read permanently healthy and hide a
+                        # routing regression; counting affinity resumes
+                        # would credit the index with what the private
+                        # design could do anyway
+                        self.shared_hits_total += 1
+                        self.tel.shared_prefix_hits.inc()
                     return slot
                 now = time.monotonic()
                 if deadline is not None and now >= deadline:
@@ -263,7 +305,8 @@ class ReplicaPool:
                     )
                 self._cond.wait(timeout=limit - now)
 
-    def _pick_slot_locked(self, messages):
+    def _pick_slot_locked(self, messages, shared=None):
+        shared = shared or {}
         for wanted in (HEALTHY, SUSPECT):
             cands = [
                 (r, s)
@@ -273,15 +316,15 @@ class ReplicaPool:
                 if not s.busy
             ]
             if cands:
-                _, slot = max(
+                return max(
                     cands,
                     key=lambda rs: (
                         rs[1].cache.match_len(messages),
+                        shared.get(rs[0].idx, 0),
                         -rs[0].active(),
                         0 if rs[1].cache.items else 1,
                     ),
                 )
-                return slot
         return None
 
     def release(self, slot) -> None:
@@ -533,6 +576,16 @@ class ReplicaPool:
             elif event == "lost":
                 if rep.state != DEAD:
                     self._set_state_locked(rep, DEAD)
+                    # drop the dead replica's chains from the shared
+                    # index (placement must never route to pages that no
+                    # longer exist) and its spill-arena entries (a
+                    # silently-corrupt replica may have spilled corrupt
+                    # KV; its rebuild starts empty regardless) — both
+                    # leaf locks, safe under _cond, atomic with the death
+                    if self.shared_index is not None:
+                        self.shared_index.drop_owner(idx)
+                    if self.spill_arena is not None:
+                        self.spill_arena.drop_owner(idx)
                     self.failovers_total += 1
                     # victims = occupied lanes on the dead replica, not the
                     # scheduler's joined count (a request between prefill
@@ -666,6 +719,12 @@ class ReplicaPool:
                     "active_rows": r.active(),
                     "slots": len(r.slots),
                     "restarts": r.restarts,
+                    # prefix-cache occupancy (ISSUE 11): device pages held
+                    # / pinned and this replica's spill-arena depth. Racy
+                    # integer reads of the scheduler's tree on purpose —
+                    # a snapshot must not take the scheduler cond (lock
+                    # order is scheduler → pool, never the reverse)
+                    "cache": self._cache_read(r),
                     # SDC canary read (ISSUE 10): "unverified" until the
                     # first conclusive probe of this generation, then
                     # "ok"/"mismatch"; age None while unprobed. A
@@ -679,6 +738,20 @@ class ReplicaPool:
                 }
                 for r in self.replicas
             ]
+
+    @staticmethod
+    def _cache_read(rep: Replica):
+        """Per-replica prefix-cache occupancy for /readyz, or None when
+        the replica has no prefix cache (batching off, misconfigured
+        pool, no scheduler)."""
+        prefix = getattr(rep.scheduler, "_prefix", None)
+        if prefix is None:
+            return None
+        return {
+            "pages": prefix.pages_in_use(),
+            "pinned": prefix.pinned_pages(),
+            "spill_depth": prefix.spill_depth(),
+        }
 
     def states(self) -> list[str]:
         with self._cond:

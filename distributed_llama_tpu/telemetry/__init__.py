@@ -359,6 +359,36 @@ class PrefixCacheInstruments:
             "(page-granular)",
             buckets=self.MATCHED_TOKEN_BUCKETS,
         )
+        # host-RAM / disk spill tier (ISSUE 11, engine/spill.py): the
+        # capacity ladder below the HBM pool
+        self.spill_pages = counter(
+            "dllama_prefix_spill_pages_total",
+            "Evicted prefix pages whose bytes spilled to the host-RAM "
+            "arena instead of vanishing (data+scales verbatim for i8)",
+        )
+        self.spill_reloads = counter(
+            "dllama_prefix_spill_reloads_total",
+            "Spilled prefix pages re-uploaded into a device pool on a "
+            "later admission match (re-upload ≪ re-prefill; CRC-verified)",
+        )
+        self.spill_dropped = counter(
+            "dllama_prefix_spill_dropped_total",
+            "Spilled prefix pages LOST from the capacity ladder: LRU "
+            "overflow past the host/disk budgets, or a CRC mismatch "
+            "detected at reload (the entry is dropped, the block "
+            "prefills cold)",
+        )
+        self.spill_resident_pages = gauge(
+            "dllama_prefix_spill_resident_pages",
+            "Spilled pages currently resident in the arena (host RAM + "
+            "disk tier), across all replicas",
+        )
+        self.spill_bytes = gauge(
+            "dllama_prefix_spill_bytes",
+            "Bytes currently resident in the host-RAM spill arena "
+            "(the --host-spill-mb budget bounds this; disk-tier bytes "
+            "are not included)",
+        )
 
 
 def note_compile_cache_hit() -> None:
@@ -489,6 +519,15 @@ class ServerInstruments:
             "Requests replayed on a surviving replica after their replica "
             "died mid-flight (pinned seed, sent SSE deltas suppressed — "
             "the stream is bit-identical to an unfaulted run)",
+        )
+        # global prefix-cache tier (ISSUE 11): placement routed by the
+        # shared radix index (engine/prefix_cache.py SharedPrefixIndex)
+        self.shared_prefix_hits = counter(
+            "dllama_prefix_shared_hits_total",
+            "Requests placed onto a replica because the shared radix "
+            "index says it owns (part of) the prompt's published prefix "
+            "chain — the cross-replica routing that keeps the Zipf head "
+            "from being re-prefilled once per replica",
         )
         # silent-data-corruption detection (ISSUE 10, engine/integrity.py
         # + server/replicas.py): canary probes, shadow votes and restart

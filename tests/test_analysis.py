@@ -263,8 +263,8 @@ def test_fault_registry_matches_shipped_sites():
         "batch.dispatch", "batch.fetch", "batch.row", "engine.forward",
         "engine.decode_dispatch", "engine.fetch", "engine.spec_verify",
         "engine.paged_attn", "engine.preempt", "engine.sdc",
-        "replica.crash", "replica.hang", "replica.slow", "tp.transfer",
-        "server.send",
+        "engine.spill", "replica.crash", "replica.hang", "replica.slow",
+        "tp.transfer", "server.send",
     }
 
 

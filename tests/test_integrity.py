@@ -561,11 +561,11 @@ class TestCanary:
         orig_place = state.pool.place
         bounced = []
 
-        def place_corrupt_once(messages, deadline=None):
+        def place_corrupt_once(messages, deadline=None, route_tokens=None):
             if not bounced:
                 bounced.append(1)
                 raise faults.ReplicaCorrupt("replica 0 lost: sdc (test)")
-            return orig_place(messages, deadline)
+            return orig_place(messages, deadline, route_tokens=route_tokens)
 
         state.pool.place = place_corrupt_once
         try:

@@ -524,8 +524,9 @@ class TestPlacementBounceAccounting:
         replayed-vs-victims health read in OBSERVABILITY.md."""
         assert issubclass(NoPlaceableReplica, faults.ReplicaLost)
         state = make_replica_state(tmp_path, "bounce", replicas=1, parallel=2)
-        state.pool.place = lambda messages, deadline=None: (_ for _ in ()).throw(
-            NoPlaceableReplica("every replica down")
+        state.pool.place = (
+            lambda messages, deadline=None, route_tokens=None:
+            (_ for _ in ()).throw(NoPlaceableReplica("every replica down"))
         )
         with pytest.raises(faults.ReplicaLost):
             state.complete(
