@@ -279,13 +279,14 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
     from distributed_llama_tpu.models.sampling import decode_chunk
 
     warm, cache = decode_loop(cfg, params, token, cache, jnp.int32(single_base), steps,
-                              0.0, 0.9, jax.random.PRNGKey(0))
+                              0.0, 0.9, seed=0)
     np.asarray(warm)
     token = warm[-1]
     chunk = 32
-    key = jax.random.PRNGKey(2)
-    toks, cache, key = decode_chunk(cfg, params, token, cache, jnp.int32(chunk_base), chunk,
-                                    jnp.float32(0.0), jnp.float32(0.9), key)  # warm/compile
+    seed32 = jnp.uint32(2)
+    toks, cache = decode_chunk(cfg, params, token, cache, jnp.int32(chunk_base), chunk,
+                               jnp.float32(0.0), jnp.float32(0.9),
+                               jnp.int32(0), seed32)  # warm/compile
     np.asarray(toks)
 
     # single-dispatch and chunked (user-path) decode, INTERLEAVED with
@@ -301,7 +302,7 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
         with telemetry.trace_span("bench_decode_single", rep=rep):
             sw = Stopwatch()
             tokens, cache = decode_loop(cfg, params, token, cache, jnp.int32(single_base),
-                                        steps, 0.0, 0.9, jax.random.PRNGKey(1))
+                                        steps, 0.0, 0.9, seed=1)
             np.asarray(tokens)
             single_runs.append(steps / sw.elapsed_s())
 
@@ -311,8 +312,9 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
             # pipelined like engine.generate_chunks: dispatch the next chunk
             # off the device-resident last token, start the previous chunk's
             # host copy, then block on it — fetch overlaps compute
-            nxt, cache, key = decode_chunk(cfg, params, toks[-1], cache, jnp.int32(pos),
-                                           chunk, jnp.float32(0.0), jnp.float32(0.9), key)
+            nxt, cache = decode_chunk(cfg, params, toks[-1], cache, jnp.int32(pos),
+                                      chunk, jnp.float32(0.0), jnp.float32(0.9),
+                                      jnp.int32(0), seed32)
             try:
                 toks.copy_to_host_async()
             except Exception:
@@ -416,11 +418,11 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
     for i in range(B):
         logits, caches[i] = fwd(cfg, params, prompts[i], caches[i], jnp.int32(0))
         tok_dev.append(jnp.argmax(logits[-1]).astype(jnp.int32))
-    keys = [jax.random.PRNGKey(i) for i in range(B)]
+    seeds32 = [jnp.uint32(i) for i in range(B)]
     # warm/compile the chunk shape once
-    warm, caches[0], keys[0] = decode_chunk(
+    warm, caches[0] = decode_chunk(
         cfg, params, tok_dev[0], caches[0], jnp.int32(base), chunk,
-        jnp.float32(0.0), jnp.float32(0.9), keys[0],
+        jnp.float32(0.0), jnp.float32(0.9), jnp.int32(0), seeds32[0],
     )
     np.asarray(warm)
     single_runs = []
@@ -431,9 +433,10 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
             last = None
             for _ in range(n_rounds):
                 for i in range(B):
-                    toks, caches[i], keys[i] = decode_chunk(
+                    toks, caches[i] = decode_chunk(
                         cfg, params, tok_dev[i], caches[i], jnp.int32(pos[i]),
-                        chunk, jnp.float32(0.0), jnp.float32(0.9), keys[i],
+                        chunk, jnp.float32(0.0), jnp.float32(0.9),
+                        jnp.int32(0), seeds32[i],
                     )
                     tok_dev[i] = toks[-1]
                     pos[i] += chunk
@@ -457,10 +460,12 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
     active = jnp.ones(B, bool)
     temps = jnp.zeros(B, jnp.float32)
     topps = jnp.full(B, 0.9, jnp.float32)
-    bkeys = jnp.stack([jax.random.PRNGKey(i) for i in range(B)])
+    bseeds = jnp.arange(B, dtype=jnp.uint32)
+    btopks = jnp.zeros(B, jnp.int32)
     pos0 = jnp.full(B, base, jnp.int32)
-    toks, slab, bkeys = decode_chunk_batched(  # warm/compile
-        cfg, params, first, slab, pos0, active, chunk, temps, topps, bkeys
+    toks, slab = decode_chunk_batched(  # warm/compile
+        cfg, params, first, slab, pos0, active, chunk, temps, topps, btopks,
+        bseeds,
     )
     np.asarray(toks)
     batch_runs = []
@@ -472,8 +477,9 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
         with telemetry.trace_span("bench_batch_decode", rep=rep, b=B):
             sw = Stopwatch()
             for _ in range(n_rounds):
-                toks_r, slab, bkeys = decode_chunk_batched(
-                    cfg, params, nxt, slab, pos, active, chunk, temps, topps, bkeys
+                toks_r, slab = decode_chunk_batched(
+                    cfg, params, nxt, slab, pos, active, chunk, temps, topps,
+                    btopks, bseeds,
                 )
                 nxt = toks_r[chunk - 1]
                 pos = pos + chunk
@@ -497,6 +503,230 @@ def run_batch(cfg, name: str, B: int, prefill_len: int = 64, chunk: int = 32,
             "chunk": chunk,
             "baseline": "B round-robin-interleaved single-sequence chunked "
             "decode streams on the same chip (docs/PERF.md round-5 item 4)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def sampled_probe_config(seq_len: int = 512):
+    """A CPU-runnable shape with a PRODUCTION-WIDTH vocabulary: the fused
+    sampler's cost scales with vocab (top-k window + softmax), so the
+    sampled-vs-greedy A/B must not flatter itself on a toy vocab. The
+    transformer stack is small on purpose — the question under test is
+    what sampling adds to a step, relative, on the same device."""
+    from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct, RopeType
+    from distributed_llama_tpu.models.config import LlamaConfig
+
+    return LlamaConfig(
+        arch=ArchType.LLAMA,
+        dim=256,
+        hidden_dim=512,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,
+        vocab_size=32000,
+        seq_len=seq_len,
+        head_size=64,
+        kv_dim=256,
+        hidden_act=HiddenAct.SILU,
+        rope_type=RopeType.LLAMA,
+        rope_theta=10000.0,
+    )
+
+
+def run_sampled(cfg, name: str, B: int = 4, prefill_len: int = 32,
+                chunk: int = 32, n_rounds: int = 4, weights: str = "bf16") -> dict:
+    """``bench.py --sampled``: the ISSUE 13 A/B. Two gates, both relative
+    on the SAME device (CPU-host or TPU — no cross-backend games):
+
+    * single-stream: the fused sampled path (temperature/top-p + counter
+      PRNG inside the decode scan) vs the greedy argmax path — the fused
+      sampler must cost ≤ ~5% of a decode step (``sampled_vs_greedy``).
+    * B-row aggregate: the batched DEVICE-sampled decode vs the host
+      sampler baseline (per-token logits fetch + host sort, the
+      reference's root-node regime, src/apps/dllama/dllama.cpp) —
+      the multiplier batching buys once sampling stops serializing rows
+      on the host (``device_vs_host_sampler``)."""
+    import functools
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.engine.batch import _slab_prefill_single
+    from distributed_llama_tpu.engine.weights import random_params_on_device
+    from distributed_llama_tpu.models import llama
+    from distributed_llama_tpu.models.sampling import (
+        decode_chunk,
+        decode_chunk_batched,
+    )
+    from distributed_llama_tpu.tokenizer import Sampler
+
+    if weights == "q40":
+        params = random_q40_params_on_device(cfg)
+    else:
+        params = random_params_on_device(
+            cfg, dtype=jnp.bfloat16, seed=0, layered=True
+        )
+
+    rng = np.random.RandomState(0)
+    prompts = [
+        jnp.asarray(rng.randint(0, cfg.vocab_size, prefill_len, dtype=np.int32))
+        for _ in range(B)
+    ]
+    base = prefill_len
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+    def fwd(cfg_, params_, tokens, cache, pos):
+        return llama.forward_tokens(cfg_, params_, tokens, cache, pos)
+
+    # ---- single-stream: greedy vs sampled, same fixed decode window ------
+    cache = llama.init_cache(cfg, dtype=jnp.bfloat16, layered=True)
+    logits, cache = fwd(cfg, params, prompts[0], cache, jnp.int32(0))
+    tok0 = jnp.argmax(logits[-1]).astype(jnp.int32)
+    seed32 = jnp.uint32(7)
+
+    def single_arm(temp, topp, topk=0):
+        nonlocal cache
+        t = jnp.float32(temp)
+        p = jnp.float32(topp)
+        k = jnp.int32(topk)
+        warm, cache = decode_chunk(
+            cfg, params, tok0, cache, jnp.int32(base), chunk, t, p,
+            k, seed32,
+        )
+        np.asarray(warm)
+        runs = []
+        for rep in range(3):
+            pos = base
+            tok = tok0
+            with telemetry.trace_span("bench_sampled_single", rep=rep, t=temp):
+                sw = Stopwatch()
+                for _ in range(n_rounds):
+                    toks, cache_new = decode_chunk(
+                        cfg, params, tok, cache, jnp.int32(pos), chunk, t, p,
+                        k, seed32,
+                    )
+                    cache = cache_new
+                    tok = toks[-1]
+                    pos += chunk
+                np.asarray(toks)
+                runs.append(n_rounds * chunk / sw.elapsed_s())
+        return median(runs)
+
+    # interleave-free but adjacent: the two arms run the identical
+    # windows. The sampled arm uses the production-shaped filter combo
+    # (top-p 0.9 ∧ top-k 64): random-weight logits are near-FLAT, so a
+    # bare top-p nucleus overflows the fast window every step and the A/B
+    # would measure the full-sort fallback, which trained-model logits
+    # (peaked; nucleus ≪ 128 wide) never take — the in-window top-k pins
+    # the bench to the path production actually runs
+    greedy_tps = single_arm(0.0, 0.9, 64)
+    sampled_tps = single_arm(0.8, 0.9, 64)
+    ratio = sampled_tps / greedy_tps if greedy_tps else 0.0
+    del cache
+    gc.collect()
+
+    # ---- B-row aggregate: batched device-sampled vs host sampler ---------
+    slab = llama.init_batch_cache(cfg, B, dtype=jnp.bfloat16)
+    firsts = []
+    for i in range(B):
+        logits, slab = _slab_prefill_single(
+            cfg, params, prompts[i], slab, jnp.int32(i), jnp.int32(0),
+            jnp.int32(prefill_len),
+        )
+        firsts.append(jnp.argmax(logits[-1]).astype(jnp.int32))
+    first = jnp.stack(firsts)
+    active = jnp.ones(B, bool)
+    temps = jnp.full(B, 0.8, jnp.float32)
+    topps = jnp.full(B, 0.9, jnp.float32)
+    topks = jnp.full(B, 64, jnp.int32)
+    bseeds = jnp.arange(B, dtype=jnp.uint32)
+    pos0 = jnp.full(B, base, jnp.int32)
+    toks, slab = decode_chunk_batched(  # warm/compile
+        cfg, params, first, slab, pos0, active, chunk, temps, topps, topks,
+        bseeds,
+    )
+    np.asarray(toks)
+    batch_runs = []
+    for rep in range(3):
+        pos = pos0
+        nxt = toks[chunk - 1]
+        with telemetry.trace_span("bench_sampled_batched", rep=rep, b=B):
+            sw = Stopwatch()
+            for _ in range(n_rounds):
+                toks_r, slab = decode_chunk_batched(
+                    cfg, params, nxt, slab, pos, active, chunk, temps, topps,
+                    topks, bseeds,
+                )
+                nxt = toks_r[chunk - 1]
+                pos = pos + chunk
+            np.asarray(toks_r)
+            batch_runs.append(B * n_rounds * chunk / sw.elapsed_s())
+    batched_tps = median(batch_runs)
+    del slab
+    gc.collect()
+
+    # host-sampler baseline: B round-robin streams, each token a full-vocab
+    # logits fetch + host top-p sort + a dispatch that cannot start until
+    # the host sees the previous sample (the strict data dependence the
+    # fused path deletes). Fewer steps — it is slow by construction.
+    caches = [llama.init_cache(cfg, dtype=jnp.bfloat16, layered=True) for _ in range(B)]
+    host_tok = []
+    for i in range(B):
+        logits, caches[i] = fwd(cfg, params, prompts[i], caches[i], jnp.int32(0))
+        host_tok.append(int(np.argmax(np.asarray(logits[-1]))))
+    samplers = [
+        Sampler(vocab_size=cfg.vocab_size, temperature=0.8, topp=0.9,
+                topk=64, seed=i, counter=True)
+        for i in range(B)
+    ]
+    host_steps = max(8, chunk // 2)
+    # warm the 1-token forward shape
+    logits, caches[0] = fwd(
+        cfg, params, jnp.asarray([host_tok[0]], jnp.int32), caches[0],
+        jnp.int32(base),
+    )
+    host_tok[0] = samplers[0].sample(np.asarray(logits[0]), pos=base)
+    pos_h = [base + (1 if i == 0 else 0) for i in range(B)]
+    with telemetry.trace_span("bench_sampled_host_baseline", b=B):
+        sw = Stopwatch()
+        done = 0
+        for _ in range(host_steps):
+            for i in range(B):
+                logits, caches[i] = fwd(
+                    cfg, params, jnp.asarray([host_tok[i]], jnp.int32),
+                    caches[i], jnp.int32(pos_h[i]),
+                )
+                host_tok[i] = samplers[i].sample(
+                    np.asarray(logits[0]), pos=pos_h[i]
+                )
+                pos_h[i] += 1
+                done += 1
+        host_tps = done / sw.elapsed_s()
+    speedup = batched_tps / host_tps if host_tps else 0.0
+
+    return {
+        "metric": f"{name}_{weights}_device_sampled_tokens_per_sec",
+        "value": round(bench_metric("sampled_decode_tps", sampled_tps,
+                                    "tokens/sec"), 2),
+        "unit": "tokens/sec",
+        "sampled_vs_greedy": round(bench_metric("sampled_vs_greedy", ratio), 4),
+        "device_vs_host_sampler": round(
+            bench_metric("device_vs_host_sampler", speedup), 2),
+        "detail": {
+            "greedy_decode_tokens_per_sec": round(
+                bench_metric("greedy_decode_tps", greedy_tps, "tokens/sec"), 2),
+            "batched_sampled_aggregate_tokens_per_sec_b4": round(
+                bench_metric("batched_sampled_tps", batched_tps, "tokens/sec"), 2),
+            "host_sampler_aggregate_tokens_per_sec_b4": round(
+                bench_metric("host_sampler_tps", host_tps, "tokens/sec"), 2),
+            "b": B,
+            "chunk": chunk,
+            "sampler": "temperature 0.8, top-p 0.9, top-k 64, counter-PRNG seeds",
+            "baseline": "per-token full-vocab logits fetch + host top-p "
+            "sort, B round-robin streams (the reference's root-node "
+            "sampler regime, src/apps/dllama/dllama.cpp:45-59)",
             "device": str(jax.devices()[0]),
         },
     }
@@ -547,15 +777,15 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
     chunk = 32
 
     # ---- plain chunked decode baseline (the 108.3 tok/s serving path) ----
-    key = jax.random.PRNGKey(2)
-    toks, cache, key = decode_chunk(  # warm/compile
+    seed32 = jnp.uint32(2)
+    toks, cache = decode_chunk(  # warm/compile
         cfg, params, jnp.int32(first), cache, jnp.int32(base), chunk,
-        jnp.float32(0.0), jnp.float32(0.9), key,
+        jnp.float32(0.0), jnp.float32(0.9), jnp.int32(0), seed32,
     )
     np.asarray(toks)
     n_chunks = max(1, n_tokens // chunk)
 
-    def plain_round(cache_, key_, span_name, rep):
+    def plain_round(cache_, span_name, rep):
         """One timed plain-decode replay of the fixed window — ONE copy of
         the measurement loop, shared by the baseline arm and the --spec 0
         A/A rerun arm so the comparison is provably the same procedure."""
@@ -565,19 +795,19 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
         sw = Stopwatch()
         with telemetry.trace_span(span_name, rep=rep):
             for _ in range(n_chunks):
-                toks_, cache_, key_ = decode_chunk(
+                toks_, cache_ = decode_chunk(
                     cfg, params, tok_dev, cache_, jnp.int32(pos), chunk,
-                    jnp.float32(0.0), jnp.float32(0.9), key_,
+                    jnp.float32(0.0), jnp.float32(0.9), jnp.int32(0), seed32,
                 )
                 tok_dev = toks_[-1]
                 pos += chunk
                 got.extend(np.asarray(toks_).tolist())
-        return cache_, key_, n_chunks * chunk / sw.elapsed_s(), got
+        return cache_, n_chunks * chunk / sw.elapsed_s(), got
 
     plain_runs = []
     plain_out = None
     for rep in range(3):
-        cache, key, tps, plain_out = plain_round(cache, key, "bench_spec_plain", rep)
+        cache, tps, plain_out = plain_round(cache, "bench_spec_plain", rep)
         plain_runs.append(tps)
     plain_tps = median(plain_runs)
 
@@ -592,16 +822,16 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
         prev = first
         pos = base
         emitted = []
-        key_ = jax.random.PRNGKey(3)
         sw = Stopwatch()
         while len(emitted) < n_tokens:
             T = min(k + 1, cfg.seq_len - pos)
             draft = drafter.draft(history, limit=T - 1) if k > 0 else []
             feed = np.full(T, prev, np.int32)
             feed[1 : 1 + len(draft)] = draft
-            out_dev, cache_, key_ = spec_verify_step(
+            out_dev, cache_ = spec_verify_step(
                 cfg, params, jnp.asarray(feed), cache_, jnp.int32(pos),
-                jnp.int32(len(draft)), jnp.float32(0.0), jnp.float32(0.9), key_,
+                jnp.int32(len(draft)), jnp.float32(0.0), jnp.float32(0.9),
+                jnp.int32(0), jnp.uint32(3),
             )
             out = np.asarray(out_dev)
             n_emit = max(1, min(int(out[0]), T))
@@ -630,8 +860,8 @@ def run_spec(cfg, name: str, k: int, prefill_len: int = 64, n_tokens: int = 128,
         # of reporting 1.0 by construction
         rerun_runs = []
         for rep in range(3):
-            cache, key, tps, spec_out = plain_round(
-                cache, key, "bench_spec_plain_rerun", rep
+            cache, tps, spec_out = plain_round(
+                cache, "bench_spec_plain_rerun", rep
             )
             rerun_runs.append(tps)
         spec_tps = median(rerun_runs)
@@ -731,7 +961,7 @@ def run_chaos(b: int = 4, n_tokens: int = 64, chunk: int = 8) -> dict:
                 s = streams[i]
                 try:
                     s.reset()
-                    first, key = s.prefill_device(prompts[i], 0.0, 0.9, i)
+                    first = s.prefill_device(prompts[i], 0.0, 0.9, i)
                     got = []
 
                     def on_token(prev, tok):
@@ -740,7 +970,7 @@ def run_chaos(b: int = 4, n_tokens: int = 64, chunk: int = 8) -> dict:
 
                     s.stream_decode(
                         first, on_token, 0.0, 0.9, seed=i,
-                        limit=s.pos + n_tokens, key=key,
+                        limit=s.pos + n_tokens,
                         first_prev=prompts[i][-1],
                     )
                     with lock:
@@ -899,7 +1129,7 @@ def run_prefix_cache(chaos: bool = False) -> dict:
         (prefill_device fusion + fused first-token fetch)."""
         stream.reset()
         sw = Stopwatch()
-        first, _key = stream.prefill_device(tokens, 0.0, 0.9, seed)
+        first = stream.prefill_device(tokens, 0.0, 0.9, seed)
         stream.fetch_first_token(first)
         return sw.elapsed_ms()
 
@@ -1004,7 +1234,7 @@ def run_prefix_cache(chaos: bool = False) -> dict:
         # reader — docs/PERF.md "Zero-copy paged attention"
         def greedy(stream, tokens, n=16):
             stream.reset()
-            first, key = stream.prefill_device(tokens, 0.0, 0.9, 0)
+            first = stream.prefill_device(tokens, 0.0, 0.9, 0)
             got = []
 
             def on_token(prev, tok):
@@ -1013,7 +1243,7 @@ def run_prefix_cache(chaos: bool = False) -> dict:
 
             stream.stream_decode(
                 first, on_token, 0.0, 0.9, seed=0, limit=stream.pos + n,
-                key=key, first_prev=tokens[-1],
+                first_prev=tokens[-1],
             )
             return got
 
@@ -1267,6 +1497,12 @@ if __name__ == "__main__":
         idx = sys.argv.index("--batch-decode")
         b = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 4
         main_batch(b)
+    elif "--sampled" in sys.argv:
+        # device-resident sampling A/B (ISSUE 13): fused sampled vs greedy
+        # single-stream, batched device-sampled vs host-sampler baseline
+        # at B=4 — both relative, same device (numbers → docs/PERF.md)
+        result = run_sampled(sampled_probe_config(512), "sampled_probe")
+        print(json.dumps(result))
     elif "--spec" in sys.argv:
         # self-speculative decode (ISSUE 6): prompt-lookup drafts verified
         # k at a time vs plain chunked decode, acceptance rate in the JSON;

@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument(
+        "--topk", type=int, default=0,
+        help="top-k sampling filter (0 = off); composes with --topp as "
+        "min(top-k, nucleus), fused into the device decode program",
+    )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel shards (chips)")
@@ -84,9 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--decode",
         choices=["device", "host"],
         default="device",
-        help="device = chunked on-device decode+sampling (fast path, jax.random); "
-        "host = per-token host sampling (the reference's regime, xorshift-parity "
-        "sampler, one host<->device round trip per token)",
+        help="device = chunked on-device decode+sampling (fast path: fused "
+        "temperature/top-k/top-p + counter-PRNG coins inside the decode "
+        "program); host = per-token host sampling (the reference's regime, "
+        "one host<->device round trip per token; the counter-mode xorshift "
+        "sampler replays the device stream token for token per seed)",
     )
     p.add_argument(
         "--decode-chunk", type=int, default=32,
@@ -178,11 +185,17 @@ def make_engine(args):
     tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
     # wall-clock as entropy for a default sampling seed, never a duration
     seed = args.seed if args.seed is not None else int(time.time())  # dllama: noqa[CLK-001]
+    # counter mode: the host sampler draws the SAME coins the fused device
+    # sampler draws (stateless, keyed on (seed, position)), so a --decode
+    # host run replays a --decode device stream token for token — the
+    # xorshift-parity verification mode (ISSUE 13)
     sampler = Sampler(
         vocab_size=engine.cfg.vocab_size,
         temperature=args.temperature,
         topp=args.topp,
+        topk=args.topk,
         seed=seed,
+        counter=True,
     )
     return engine, tokenizer, sampler
 
@@ -217,8 +230,9 @@ def generate(args, benchmark: bool) -> None:
         # prefill→decode fusion: the first token is sampled on device and the
         # first decode chunk is dispatched before anything is fetched — one
         # tunnel round trip per request instead of two (engine.prefill_device)
-        first_dev, key = engine.prefill_device(
-            prompt_tokens, args.temperature, args.topp, seed=sampler.seed
+        first_dev = engine.prefill_device(
+            prompt_tokens, args.temperature, args.topp, seed=sampler.seed,
+            topk=args.topk,
         )
         logits = None
     else:
@@ -272,22 +286,25 @@ def generate(args, benchmark: bool) -> None:
         engine.stream_decode(
             first_dev, on_token, args.temperature, args.topp,
             seed=sampler.seed, chunk=args.decode_chunk, limit=args.steps,
-            key=key, first_prev=prompt_tokens[-1],
+            first_prev=prompt_tokens[-1],
             spec_draft=getattr(args, "spec_draft", 0),
             spec_ngram=getattr(args, "spec_ngram", 3),
             prompt_tokens=prompt_tokens,
+            topk=args.topk,
         )
         print_p_line()  # zero-token streams (immediate BOS) still report P
     else:
-        # first generated token samples on host from the prefill logits
-        next_token = sampler.sample(logits)
+        # first generated token samples on host from the prefill logits;
+        # the counter sampler keys each coin on the consumed position, so
+        # this stepwise stream is token-identical to --decode device
+        next_token = sampler.sample(logits, pos=engine.pos - 1)
         if next_token != tokenizer.bos_id:  # BOS delimits sequences (dllama.cpp:68-71)
             emit(token, next_token)
             generated += 1
             token = next_token
             while engine.pos < args.steps:
                 logits = engine.decode_step(token)
-                next_token = sampler.sample(logits)
+                next_token = sampler.sample(logits, pos=engine.pos - 1)
                 if next_token == tokenizer.bos_id:
                     break
                 emit(token, next_token)
@@ -331,11 +348,13 @@ def chat(args) -> None:
         budget = seq_len - engine.pos
         tokens = tokens[:budget]
         turn_seed = sampler.seed + engine.pos  # vary the stream per turn
+        sampler.set_seed(turn_seed)  # counter coins re-key per turn too
         if args.decode == "device":
             # prefill→decode fusion (see generate): first token sampled on
             # device, no host round trip between prompt and reply
-            first_dev, key = engine.prefill_device(
-                tokens, args.temperature, args.topp, seed=turn_seed
+            first_dev = engine.prefill_device(
+                tokens, args.temperature, args.topp, seed=turn_seed,
+                topk=args.topk,
             )
             logits = None
         else:
@@ -368,20 +387,21 @@ def chat(args) -> None:
             engine.stream_decode(
                 first_dev, on_token, args.temperature, args.topp,
                 seed=turn_seed, chunk=args.decode_chunk,
-                limit=seq_len, key=key, first_prev=tokens[-1],
+                limit=seq_len, first_prev=tokens[-1],
                 spec_draft=getattr(args, "spec_draft", 0),
                 spec_ngram=getattr(args, "spec_ngram", 3),
                 prompt_tokens=tokens,
+                topk=args.topk,
             )
         else:
             prev = tokens[-1]
-            token = sampler.sample(logits)
+            token = sampler.sample(logits, pos=engine.pos - 1)
             res = feed(prev, token)
             if res != EosDetectorResult.EOS and engine.pos < seq_len:
                 while engine.pos < seq_len:
                     logits = engine.decode_step(token)
                     prev = token
-                    token = sampler.sample(logits)
+                    token = sampler.sample(logits, pos=engine.pos - 1)
                     res = feed(prev, token)
                     if res == EosDetectorResult.EOS:
                         break

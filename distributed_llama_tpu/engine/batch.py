@@ -50,10 +50,14 @@ Architecture
   is attending over — chaos-enforced, and a prefix-hit stream is
   bit-identical to the cold prefill (tests/test_prefix_cache.py,
   tests/test_paged_attention.py).
-* Per-row PRNG keys, temperatures and top-p thread through the batched
-  program, so a row's token stream is bit-identical to the single-stream
-  chunked decode for the same per-row key (tests/test_batch_decode.py) and
-  requests with different sampling settings share one compiled program.
+* Per-row seeds, temperatures, top-p and top-k ride the batched program;
+  sampling is fused into the scan on counter-PRNG coins keyed
+  ``(seed, position)`` (ISSUE 13), so a row's token stream is
+  bit-identical to the single-stream chunked decode for the same request
+  seed (tests/test_batch_decode.py), requests with different sampling
+  settings share one compiled program, and no sampler state exists for
+  the scheduler to thread — a requeued or failed-over row re-draws its
+  coins from its seed and positions alone.
   (MoE models: the batched step uses dense expert mixing — parity holds up
   to expert-sum reordering, and expert HBM reads amortize only once
   B ≥ E/k; see ``llama.forward_step_batched``.)
@@ -201,9 +205,10 @@ class BatchStream:
         self._joined = False
         self._epoch = 0  # bumped per join/leave: stale fetches can't deliver
         self._first = None  # device scalar (or host int) feeding the next chunk
-        self._key = None  # per-row PRNG key, advanced per chunk
+        self._seed32 = 0  # folded uint32 request seed (stateless counter PRNG)
         self._temperature = 0.0
         self._topp = 0.9
+        self._topk = 0
         self._pending_prefill_entry: TokenStats | None = None
         self._depth_held = False
         # per-request deadline (time.monotonic seconds) set by the serving
@@ -349,11 +354,13 @@ class BatchStream:
             engine._tel.kv_occupancy.set(self.pos / engine.cfg.seq_len)
         return out
 
-    def prefill_device(self, tokens, temperature, topp, seed: int):
+    def prefill_device(self, tokens, temperature, topp, seed: int, topk: int = 0):
         """Prefill + sample the first token ON DEVICE (the prefill→decode
         fusion of EngineStream.prefill_device, on this slab row): returns
-        (device token scalar, PRNG key) — nothing visits the host until the
-        fused first-token fetch overlaps chunk 1's compute."""
+        the device token scalar — nothing visits the host until the fused
+        first-token fetch overlaps chunk 1's compute. The coin is keyed on
+        the last prompt token's absolute position, so a requeue/failover
+        re-run draws it identically with no sampler state shipped."""
         engine = self.engine
         tokens = np.asarray(tokens, dtype=np.int32)
         n = tokens.shape[0]
@@ -364,12 +371,17 @@ class BatchStream:
                 "prefill_dispatch", tokens=n, pos=self.pos, batch_row=self.row
             ):
                 logits, last = self.scheduler._prefill_row(self, tokens)
-                key = jax.random.PRNGKey(seed)
-                key, sub = jax.random.split(key)
-                token = engine._sample_row(
-                    logits, jnp.int32(last), sub,
-                    jnp.float32(temperature), jnp.float32(topp),
-                )
+                with engine._tel.span(
+                    "device_sample", pos=self.pos - 1, batch_row=self.row
+                ):
+                    from distributed_llama_tpu import prng
+
+                    token = engine._sample_row(
+                        logits, jnp.int32(last),
+                        jnp.uint32(prng.fold_seed(seed)),
+                        jnp.int32(self.pos - 1), jnp.float32(temperature),
+                        jnp.float32(topp), jnp.int32(topk),
+                    )
             entry = engine._split_stats(sw.elapsed_ms(), n_tokens=n)
             self.stats.append(entry)
             self._pending_prefill_entry = entry
@@ -378,7 +390,7 @@ class BatchStream:
         except BaseException:
             self._release_depth()
             raise
-        return token, key
+        return token
 
     def fetch_first_token(self, first_token) -> int:
         """Fetch a :meth:`prefill_device` token without starting a decode
@@ -405,6 +417,7 @@ class BatchStream:
             if tel.enabled:
                 tel.prefill_latency.observe(entry.generation_ms / 1000.0)
                 tel.tokens_generated.inc(1)
+                tel.device_sampled_tokens.inc(1)
                 tel.kv_occupancy.set(self.pos / engine.cfg.seq_len)
         return tok
 
@@ -435,11 +448,11 @@ class BatchStream:
         seed: int = 0,
         chunk: int | None = None,
         limit: int | None = None,
-        key=None,
         first_prev: int | None = None,
         spec_draft: int = 0,
         spec_ngram: int = 3,
         prompt_tokens=None,
+        topk: int = 0,
     ) -> int:
         """EngineStream.stream_decode over the shared batched dispatch: this
         stream joins the scheduler's active set and consumes its row of
@@ -457,8 +470,6 @@ class BatchStream:
         parity — the scheduler's shared drafter config governs."""
         engine = self.engine
         sched = self.scheduler
-        if key is None:
-            key = jax.random.PRNGKey(seed)
         start_pos = self.pos
         stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
         fused_first = first_prev is not None
@@ -480,7 +491,7 @@ class BatchStream:
             self._history.append(prev)
             self._spec_on = bool(spec_draft and spec_draft > 0)
             first_token = prev  # host int: the next verify window's feed[0]
-        sched._join(self, first_token, temperature, topp, key)
+        sched._join(self, first_token, temperature, topp, seed, topk)
         try:
             if fused_first and not spec_mode:
                 # dispatch chunk 1 before the fused fetch so the scalar
@@ -1266,7 +1277,6 @@ class BatchScheduler:
         the zero-copy alias arrays when the pool is on (None otherwise).
         One definition so a lifecycle change to what counts as a live row
         can never reach one dispatch path and skip the other."""
-        zero_key = jax.random.PRNGKey(0)
         live = [s._joined and s._fetch_error is None for s in rows]
         pos = jnp.asarray(
             [s.pos if ok else 0 for s, ok in zip(rows, live)], jnp.int32
@@ -1278,13 +1288,16 @@ class BatchScheduler:
         topps = jnp.asarray(
             [s._topp if ok else 0.9 for s, ok in zip(rows, live)], jnp.float32
         )
-        keys = jnp.stack(
-            [s._key if ok and s._key is not None else zero_key for s, ok in zip(rows, live)]
+        topks = jnp.asarray(
+            [s._topk if ok else 0 for s, ok in zip(rows, live)], jnp.int32
+        )
+        seeds = jnp.asarray(
+            [s._seed32 if ok else 0 for s, ok in zip(rows, live)], jnp.uint32
         )
         tables = matched = None
         if self._pool is not None:
             tables, matched = self._alias_arrays_locked(rows, live)
-        return live, pos, active, temps, topps, keys, tables, matched
+        return live, pos, active, temps, topps, topks, seeds, tables, matched
 
     def _alias_row_arrays_locked(self, stream: BatchStream):
         """Single-row form of :meth:`_alias_arrays_locked` (the chunked
@@ -1310,12 +1323,17 @@ class BatchScheduler:
     # coherent per dispatch)
     # ------------------------------------------------------------------
 
-    def _join(self, stream: BatchStream, first_token, temperature, topp, key) -> None:
+    def _join(
+        self, stream: BatchStream, first_token, temperature, topp, seed, topk
+    ) -> None:
+        from distributed_llama_tpu import prng
+
         with self._cond:
             stream._first = first_token
             stream._temperature = float(temperature)
             stream._topp = float(topp)
-            stream._key = key
+            stream._topk = int(topk)
+            stream._seed32 = prng.fold_seed(seed)
             stream._queue.clear()
             stream._epoch += 1
             stream._joined = True
@@ -1626,7 +1644,7 @@ class BatchScheduler:
             return
         bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
         rows = self._streams[:bucket]
-        live, pos, active, temps, topps, keys, tables, matched = (
+        live, pos, active, temps, topps, topks, seeds, tables, matched = (
             self._row_dispatch_arrays_locked(rows)
         )
         first = jnp.stack(
@@ -1643,50 +1661,51 @@ class BatchScheduler:
 
                 if engine._tp_engine is None:
                     if self._pool is not None:
-                        out, self._slab, new_keys = (
+                        out, self._slab = (
                             sampling.decode_chunk_batched_paged(
                                 engine.cfg, engine.params, first, self._slab,
                                 pos, active, self._pool, self.chunk, temps,
-                                topps, keys, tables, matched,
+                                topps, topks, seeds, tables, matched,
                             )
                         )
                     else:
-                        out, self._slab, new_keys = sampling.decode_chunk_batched(
+                        out, self._slab = sampling.decode_chunk_batched(
                             engine.cfg, engine.params, first, self._slab, pos,
-                            active, self.chunk, temps, topps, keys,
+                            active, self.chunk, temps, topps, topks, seeds,
                         )
                 elif self._pool is not None:
-                    out, self._slab, new_keys = (
+                    out, self._slab = (
                         engine._tp_engine.batched_decode_chunk_paged(
                             engine.params, first, self._slab, self._pool, pos,
-                            active, self.chunk, temps, topps, keys, tables,
-                            matched,
+                            active, self.chunk, temps, topps, topks, seeds,
+                            tables, matched,
                         )
                     )
                 else:
-                    out, self._slab, new_keys = (
+                    out, self._slab = (
                         engine._tp_engine.batched_decode_chunk(
                             engine.params, first, self._slab, pos, active,
-                            self.chunk, temps, topps, keys,
+                            self.chunk, temps, topps, topks, seeds,
                         )
                     )
-            return out, new_keys
+            return out
 
-        result = self._run_dispatch_locked(
+        out = self._run_dispatch_locked(
             joined, dispatch,
             f"batched chunk dispatch failed after {self.retries + 1} "
             "attempts; this row's request was retired",
         )
-        if result is None:
+        if out is None:
             return
         # the packed [chunk + 2, B] bundle: token rows 0..chunk-1 plus the
-        # per-row fingerprint/finite rows (engine/integrity.py)
-        out, new_keys = result
+        # per-row fingerprint/finite rows (engine/integrity.py) — with the
+        # stateless counter PRNG those int32 rows are the ONLY bytes the
+        # chunk ever sends host-ward (no advanced keys return)
         for s in joined:
-            # the next chunk seeds from this chunk's last token and advanced
-            # key — both stay device-resident (no fetch on the critical path)
+            # the next chunk seeds from this chunk's last token, which stays
+            # device-resident (no fetch on the critical path); its coins
+            # re-key from (seed, position) — nothing else carries over
             s._first = out[self.chunk - 1, s.row]
-            s._key = new_keys[s.row]
             s.pos += self.chunk
         if engine._tel.enabled:
             engine._tel.batch_occupancy.set(len(joined) / bucket)
@@ -1726,7 +1745,7 @@ class BatchScheduler:
         S = engine.cfg.seq_len
         feed = np.zeros((bucket, T), np.int32)
         lens = np.zeros(bucket, np.int32)
-        live, pos, active, temps, topps, keys, tables, matched = (
+        live, pos, active, temps, topps, topks, seeds, tables, matched = (
             self._row_dispatch_arrays_locked(rows)
         )
         for s, ok in zip(rows, live):
@@ -1755,33 +1774,31 @@ class BatchScheduler:
                 window=T,
             ):
                 if self._pool is not None:
-                    out, self._slab, new_keys = (
+                    out, self._slab = (
                         sampling.spec_verify_chunk_batched_paged(
                             engine.cfg, engine.params, jnp.asarray(feed),
                             self._slab, pos, active, self._pool,
-                            jnp.asarray(lens), temps, topps, keys, tables,
-                            matched,
+                            jnp.asarray(lens), temps, topps, topks, seeds,
+                            tables, matched,
                         )
                     )
                 else:
-                    out, self._slab, new_keys = sampling.spec_verify_chunk_batched(
+                    out, self._slab = sampling.spec_verify_chunk_batched(
                         engine.cfg, engine.params, jnp.asarray(feed),
                         self._slab, pos, active, jnp.asarray(lens), temps,
-                        topps, keys,
+                        topps, topks, seeds,
                     )
-            return out, new_keys
+            return out
 
-        result = self._run_dispatch_locked(
+        out = self._run_dispatch_locked(
             joined, dispatch,
             f"batched verify dispatch failed after {self.retries + 1} "
             "attempts; this row's request was retired",
         )
-        if result is None:
+        if out is None:
             return
-        out, new_keys = result
-        for s in joined:
-            s._key = new_keys[s.row]  # device-resident; pos/_first wait for
-            # the fetch (the advance is variable and data-dependent)
+        # pos/_first wait for the fetch (the advance is variable and
+        # data-dependent); sampler coins re-key from (seed, position)
         tel = engine._tel
         if tel.enabled:
             tel.batch_occupancy.set(len(joined) / bucket)
@@ -1967,6 +1984,7 @@ class BatchScheduler:
             self._cond.notify_all()
         if tel.enabled and delivered:
             tel.tokens_generated.inc(self.chunk * delivered)
+            tel.device_sampled_tokens.inc(self.chunk * delivered)
             tel.decode_latency.observe(per_token_ms / 1000.0)
         # a chunk kicked WHILE this fetch was in flight may already be
         # orphaned (its kicker stopped at the fused first token and its
@@ -2055,6 +2073,7 @@ class BatchScheduler:
             self._cond.notify_all()
         if tel.enabled and delivered_tokens:
             tel.tokens_generated.inc(delivered_tokens)
+            tel.device_sampled_tokens.inc(delivered_tokens)
             tel.decode_latency.observe(
                 step_ms * delivered_rows / delivered_tokens / 1000.0
             )

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_llama_tpu import telemetry
+from distributed_llama_tpu import prng, telemetry
 from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine import weights as weights_lib
 from distributed_llama_tpu.telemetry import Stopwatch
@@ -126,12 +126,19 @@ class EngineStream:
             tel.prefill_latency.observe(entry.generation_ms / 1000.0)
             tel.kv_occupancy.set(self.pos / self.engine.cfg.seq_len)
 
-    def _note_decode(self, n_tokens: int, per_token_ms: float) -> None:
+    def _note_decode(
+        self, n_tokens: int, per_token_ms: float, device_sampled: bool = False
+    ) -> None:
         tel = self.engine._tel
         if tel.enabled:
             tel.tokens_generated.inc(n_tokens)
             tel.decode_latency.observe(per_token_ms / 1000.0)
             tel.kv_occupancy.set(self.pos / self.engine.cfg.seq_len)
+            if device_sampled:
+                # the ISSUE 13 happy-path witness: tokens whose sampling ran
+                # inside the device program (the host Sampler counts its own
+                # fallback tokens — the two counters partition decode)
+                tel.device_sampled_tokens.inc(n_tokens)
 
     # ------------------------------------------------------------------
     # Generation API
@@ -246,10 +253,14 @@ class EngineStream:
         self._note_prefill(entry)
         return logits
 
-    def prefill_device(self, tokens: list[int], temperature, topp, seed: int):
+    def prefill_device(
+        self, tokens: list[int], temperature, topp, seed: int, topk: int = 0
+    ):
         """Prefill + sample the first generated token ON DEVICE; returns the
-        sampled token as a device scalar (NOT fetched) plus the PRNG key the
-        decode stream continues from.
+        sampled token as a device scalar (NOT fetched). The coin is drawn
+        from the counter PRNG at the last prompt token's absolute position,
+        so a requeued/replayed request re-draws it exactly — no sampler
+        state exists to ship (ISSUE 13).
 
         This removes the prompt→first-token host round trip entirely: the
         returned scalar feeds :meth:`generate_chunks` without ever visiting
@@ -276,11 +287,13 @@ class EngineStream:
         try:
             with engine._tel.span("prefill_dispatch", tokens=n, pos=self.pos):
                 logits = self._forward_device(tokens)
-                key = jax.random.PRNGKey(seed)
-                key, sub = jax.random.split(key)
-                token = engine._sample_row(
-                    logits, jnp.int32(n - 1), sub, jnp.float32(temperature), jnp.float32(topp)
-                )
+                with engine._tel.span("device_sample", pos=self.pos - 1):
+                    token = engine._sample_row(
+                        logits, jnp.int32(n - 1),
+                        jnp.uint32(prng.fold_seed(seed)),
+                        jnp.int32(self.pos - 1), jnp.float32(temperature),
+                        jnp.float32(topp), jnp.int32(topk),
+                    )
             entry = engine._split_stats(
                 sw.elapsed_ms(), n_tokens=n, n_dispatches=engine._last_dispatches()
             )
@@ -294,7 +307,7 @@ class EngineStream:
         except BaseException:
             self._release_depth()
             raise
-        return token, key
+        return token
 
     def _hold_depth(self) -> None:
         """Raise the engine's in-flight depth on this stream's behalf until
@@ -326,6 +339,7 @@ class EngineStream:
         temperature: float = 0.0,
         topp: float = 0.9,
         seed: int = 0,
+        topk: int = 0,
     ) -> np.ndarray:
         """Generate n_steps tokens in ONE device program (no per-token host
         round trip). Returns int32 [n_steps]. Under TP the loop is
@@ -345,7 +359,8 @@ class EngineStream:
                 n_steps,
                 float(temperature),
                 float(topp),
-                jax.random.PRNGKey(seed),
+                seed=seed,
+                topk=topk,
             )
         else:
             tokens, self.cache = sampling.decode_loop(
@@ -357,50 +372,60 @@ class EngineStream:
                 n_steps,
                 float(temperature),
                 float(topp),
-                jax.random.PRNGKey(seed),
+                seed=seed,
+                topk=topk,
             )
         tokens = np.asarray(tokens)
         per_token_ms = sw.elapsed_ms() / n_steps
         self.stats.extend([engine._split_stats(per_token_ms)] * n_steps)
         self.pos += n_steps
-        self._note_decode(n_steps, per_token_ms)
+        self._note_decode(n_steps, per_token_ms, device_sampled=True)
         return tokens
 
-    def _dispatch_chunk(self, first_token, n_steps: int, temperature, topp, key):
+    def _dispatch_chunk(
+        self, first_token, n_steps: int, temperature, topp, topk, seed32
+    ):
         """Dispatch one decode chunk WITHOUT fetching: returns the device
-        token array and the advanced key. ``first_token`` may be a host int
-        or a device scalar (the previous chunk's last token — the pipelined
-        path never waits on it). Advances pos by n_steps."""
+        token array. ``first_token`` may be a host int or a device scalar
+        (the previous chunk's last token — the pipelined path never waits
+        on it); ``seed32`` is the folded uint32 request seed the chunk's
+        counter coins re-key from (no sampler state threads between
+        chunks). Advances pos by n_steps."""
         from distributed_llama_tpu.models import sampling
 
         engine = self.engine
         engine._faults.fire("engine.decode_dispatch")
         with engine._tel.span("decode_chunk_dispatch", pos=self.pos, steps=n_steps):
             if engine._tp_engine is not None:
-                tokens, self.cache, key = engine._tp_engine.decode_chunk(
+                tokens, self.cache = engine._tp_engine.decode_chunk(
                     engine.params, jnp.int32(first_token), self.cache, jnp.int32(self.pos),
-                    n_steps, temperature, topp, key,
+                    n_steps, temperature, topp, topk, seed32,
                 )
             else:
-                tokens, self.cache, key = sampling.decode_chunk(
+                tokens, self.cache = sampling.decode_chunk(
                     engine.cfg, engine.params, jnp.int32(first_token), self.cache,
                     jnp.int32(self.pos), n_steps, jnp.float32(temperature),
-                    jnp.float32(topp), key,
+                    jnp.float32(topp), jnp.int32(topk), seed32,
                 )
         self.pos += n_steps
-        return tokens, key
+        return tokens
 
-    def decode_chunk(self, first_token: int, n_steps: int, temperature, topp, key):
+    def decode_chunk(
+        self, first_token: int, n_steps: int, temperature, topp, seed=0, topk=0
+    ):
         """Decode ``n_steps`` tokens in one device dispatch with runtime-valued
-        temperature/topp (no recompile when a request changes them). Returns
-        (tokens np[n_steps], advanced PRNG key). Advances pos by n_steps."""
+        temperature/topp/topk (no recompile when a request changes them).
+        Returns tokens np[n_steps]. Advances pos by n_steps."""
         sw = Stopwatch()
-        tokens, key = self._dispatch_chunk(first_token, n_steps, temperature, topp, key)
+        tokens = self._dispatch_chunk(
+            first_token, n_steps, temperature, topp, topk,
+            jnp.uint32(prng.fold_seed(seed)),
+        )
         tokens = np.asarray(tokens)
         per_token_ms = sw.elapsed_ms() / n_steps
         self.stats.extend([self.engine._split_stats(per_token_ms)] * n_steps)
-        self._note_decode(n_steps, per_token_ms)
-        return tokens, key
+        self._note_decode(n_steps, per_token_ms, device_sampled=True)
+        return tokens
 
     def generate_chunks(
         self,
@@ -410,20 +435,19 @@ class EngineStream:
         seed: int = 0,
         chunk: int = 32,
         limit: int | None = None,
-        key=None,
         emit_first: bool = False,
+        topk: int = 0,
     ):
         """Generator of on-device-decoded tokens: ``chunk`` tokens per device
         dispatch (no per-token host round trip), host code between chunks.
         ``first_token`` is consumed first, not yielded — a host int, or a
-        device scalar from :meth:`prefill_device` (then pass its ``key`` too
-        and the stream continues without any host round trip; set
-        ``emit_first`` and the unseen first token is fetched and yielded
-        after chunk 1 is dispatched, its fetch overlapping the chunk's
-        compute). One PRNG key
-        threads through the chunks and is split once per step, so the stream
-        for a given seed is identical to ``generate_on_device(seed)``
-        regardless of chunk size.
+        device scalar from :meth:`prefill_device` (then the stream continues
+        without any host round trip; set ``emit_first`` and the unseen first
+        token is fetched and yielded after chunk 1 is dispatched, its fetch
+        overlapping the chunk's compute). Every step's coin is re-keyed from
+        ``(seed, position)`` by the counter PRNG, so the stream for a given
+        seed is identical to ``generate_on_device(seed)`` regardless of
+        chunk size — no sampler state threads between chunks.
 
         ``limit`` stops dispatching once ``pos`` reaches it (a stop *hint*:
         the final chunk may overshoot it — chunks keep a fixed size so XLA
@@ -443,8 +467,7 @@ class EngineStream:
         the rollback contract above.
         """
         engine = self.engine
-        if key is None:
-            key = jax.random.PRNGKey(seed)
+        seed32 = jnp.uint32(prng.fold_seed(seed))
         stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
         if self.pos >= stop:
             if emit_first:
@@ -462,14 +485,16 @@ class EngineStream:
         with engine._depth_lock:
             engine._pipeline_depth += 1
         try:
-            pending, key = self._dispatch_chunk(first_token, k, temperature, topp, key)
+            pending = self._dispatch_chunk(
+                first_token, k, temperature, topp, topk, seed32
+            )
             pending_n = k
             if emit_first:
                 # chunk 1 is already in flight: this scalar fetch overlaps
                 # its compute instead of gating the prompt→first-token path
                 yield self._fetch_fused_first(first_token)
             yield from self._generate_chunks_pipelined(
-                pending, pending_n, stop, chunk, temperature, topp, key
+                pending, pending_n, stop, chunk, temperature, topp, topk, seed32
             )
         finally:
             with engine._depth_lock:
@@ -510,11 +535,12 @@ class EngineStream:
             if tel.enabled:
                 tel.prefill_latency.observe(entry.generation_ms / 1000.0)
                 tel.tokens_generated.inc(1)
+                tel.device_sampled_tokens.inc(1)
                 tel.kv_occupancy.set(self.pos / self.engine.cfg.seq_len)
         return tok
 
     def _generate_chunks_pipelined(
-        self, pending, pending_n, stop, chunk, temperature, topp, key
+        self, pending, pending_n, stop, chunk, temperature, topp, topk, seed32
     ):
         engine = self.engine
         while True:
@@ -525,7 +551,9 @@ class EngineStream:
             # last token before fetching the pending one
             if self.pos < stop:
                 k = min(chunk, engine.cfg.seq_len - self.pos)
-                nxt, key = self._dispatch_chunk(pending[-1], k, temperature, topp, key)
+                nxt = self._dispatch_chunk(
+                    pending[-1], k, temperature, topp, topk, seed32
+                )
             else:
                 nxt, k = None, 0
             engine._faults.fire("engine.fetch")
@@ -540,7 +568,7 @@ class EngineStream:
                 toks = np.asarray(pending)
             per_token_ms = sw.elapsed_ms() / pending_n
             self.stats.extend([engine._split_stats(per_token_ms)] * pending_n)
-            self._note_decode(pending_n, per_token_ms)
+            self._note_decode(pending_n, per_token_ms, device_sampled=True)
             for t in toks.tolist():
                 yield int(t)
             if nxt is None:
@@ -556,11 +584,11 @@ class EngineStream:
         seed: int = 0,
         chunk: int = 32,
         limit: int | None = None,
-        key=None,
         first_prev: int | None = None,
         spec_draft: int = 0,
         spec_ngram: int = 3,
         prompt_tokens=None,
+        topk: int = 0,
     ) -> int:
         """Drive the chunked fast decode with host-side stop handling: the
         shared consumption loop of CLI generate/chat and the API server.
@@ -590,7 +618,7 @@ class EngineStream:
             if self.engine._tp_engine is None and not self.engine.cfg.is_moe:
                 return self._stream_decode_spec(
                     first_token, on_token, temperature, topp, seed, spec_draft,
-                    spec_ngram, limit, key, first_prev, prompt_tokens,
+                    spec_ngram, limit, first_prev, prompt_tokens, topk,
                 )
             # once per engine, not per request: the operator asked for spec
             # on a backend without it — say so instead of silently serving
@@ -610,7 +638,7 @@ class EngineStream:
         try:
             for t in self.generate_chunks(
                 first_token, temperature, topp, seed=seed, chunk=chunk,
-                limit=limit, key=key, emit_first=fused_first,
+                limit=limit, emit_first=fused_first, topk=topk,
             ):
                 consumed += 1
                 keep_going = on_token(prev, t)
@@ -648,9 +676,9 @@ class EngineStream:
         spec_draft: int,
         spec_ngram: int,
         limit: int | None,
-        key,
         first_prev: int | None,
         prompt_tokens,
+        topk: int = 0,
     ) -> int:
         """Self-speculative decode (``--spec-draft k``): per step the host
         drafts up to k tokens by prompt lookup over the request's own
@@ -672,8 +700,7 @@ class EngineStream:
         from distributed_llama_tpu.models import sampling
 
         engine = self.engine
-        if key is None:
-            key = jax.random.PRNGKey(seed)
+        seed32 = jnp.uint32(prng.fold_seed(seed))
         stop = engine.cfg.seq_len if limit is None else min(limit, engine.cfg.seq_len)
         drafter = PromptLookupDrafter(spec_draft, max_ngram=spec_ngram)
         # the lookup corpus: prompt + everything emitted (first_token is
@@ -714,10 +741,11 @@ class EngineStream:
                 with tel.span(
                     "spec_verify", pos=self.pos, window=T, drafted=len(draft)
                 ):
-                    out_dev, self.cache, key = sampling.spec_verify_step(
+                    out_dev, self.cache = sampling.spec_verify_step(
                         engine.cfg, engine.params, jnp.asarray(feed), self.cache,
                         jnp.int32(self.pos), jnp.int32(len(draft)),
-                        jnp.float32(temperature), jnp.float32(topp), key,
+                        jnp.float32(temperature), jnp.float32(topp),
+                        jnp.int32(topk), seed32,
                     )
                     out = np.asarray(out_dev)  # [T+1]: n_emit, tokens...
                 n_emit = max(1, min(int(out[0]), T))
@@ -727,6 +755,7 @@ class EngineStream:
                 self.stats.append(entry)
                 if tel.enabled:
                     tel.tokens_generated.inc(n_emit)
+                    tel.device_sampled_tokens.inc(n_emit)
                     tel.decode_latency.observe(sw.elapsed_ms() / n_emit / 1000.0)
                     tel.kv_occupancy.set(self.pos / engine.cfg.seq_len)
                     tel.spec_draft_tokens.inc(len(draft))
@@ -974,8 +1003,10 @@ class InferenceEngine:
     def prefill(self, tokens) -> np.ndarray:
         return self.default_stream.prefill(tokens)
 
-    def prefill_device(self, tokens, temperature, topp, seed: int):
-        return self.default_stream.prefill_device(tokens, temperature, topp, seed)
+    def prefill_device(self, tokens, temperature, topp, seed: int, topk: int = 0):
+        return self.default_stream.prefill_device(
+            tokens, temperature, topp, seed, topk
+        )
 
     def decode_step(self, token: int) -> np.ndarray:
         return self.default_stream.decode_step(token)
@@ -1094,12 +1125,13 @@ class InferenceEngine:
             per_entry_ms, per_entry_ms - transfer, transfer, n_tokens=n_tokens
         )
 
-    def _sample_row(self, logits, row, sub, temperature, topp):
+    def _sample_row(self, logits, row, seed32, pos, temperature, topp, topk):
         """Sample from one row of device logits entirely on device (the
-        prefill→decode fusion: no logits fetch). Under TP/SP the logits
-        returned by the backend's forward are already full-vocab and
-        replicated, so a replicated sample is correct on every backend."""
-        return _sample_row_jit(logits, row, sub, temperature, topp)
+        prefill→decode fusion: no logits fetch), coin keyed on the row's
+        absolute position. Under TP/SP the logits returned by the backend's
+        forward are already full-vocab and replicated, so a replicated
+        sample is correct on every backend (same counter → same token)."""
+        return _sample_row_jit(logits, row, seed32, pos, temperature, topp, topk)
 
     @staticmethod
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -1108,7 +1140,7 @@ class InferenceEngine:
 
 
 @jax.jit
-def _sample_row_jit(logits, row, sub, temperature, topp):
+def _sample_row_jit(logits, row, seed32, pos, temperature, topp, topk):
     from distributed_llama_tpu.models import sampling
 
-    return sampling.sample_token(logits[row], sub, temperature, topp)
+    return sampling.sample_token(logits[row], seed32, pos, temperature, topp, topk)

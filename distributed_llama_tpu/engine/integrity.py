@@ -10,15 +10,20 @@ detection primitives the serving layer composes into canaries, shadow
 votes and restart verification (server/replicas.py):
 
 * **Logit fingerprints** — a per-row FNV-1a fold over each decode step's
-  full-vocab logit sum and sampled token, carried through the batched
+  full-vocab logit argmax and sampled token, carried through the batched
   decode scan ON DEVICE and fetched as two extra int32 rows packed into
   the chunk's token array (``pack_chunk_outputs``) — the fetch count, and
-  therefore the tunnel round-trips per chunk, are unchanged. A pinned
-  greedy prompt then has ONE expected (tokens, fingerprint) pair per
-  weights+config, which is what the canary compares. The fold also
-  carries a per-row finiteness flag, closing the sampled-path hole: NaN
-  logits pushed through a softmax can launder into a perfectly in-vocab
-  token id that the fetch-side vocab check cannot see.
+  therefore the tunnel round-trips per chunk, are unchanged. Since
+  ISSUE 13 the fold shares the scan with the FUSED device sampler: the
+  packed bundle's int32 rows are the only bytes a chunk ever sends
+  host-ward, and the fold keeps its order-statistic stability across
+  bucket shapes (argmax, never a bitwise sum) while the sampler's coins
+  come from the stateless counter PRNG beside it. A pinned greedy prompt
+  then has ONE expected (tokens, fingerprint) pair per weights+config,
+  which is what the canary compares. The fold also carries a per-row
+  finiteness flag, closing the sampled-path hole: NaN logits pushed
+  through a softmax can launder into a perfectly in-vocab token id that
+  the fetch-side vocab check cannot see.
 * **Weight checksums** — an order-independent wrapping uint32 word sum
   per leaf (floats bit-cast, so a single mantissa-bit flip ALWAYS moves
   the sum — a float32 accumulation would round it away), folded through

@@ -66,6 +66,22 @@ def build_parser() -> argparse.ArgumentParser:
         "slo_ttft_ms=T,slo_e2e_ms=E,max_tokens=M;...' (default: one "
         "'default' tenant)",
     )
+    # sampled traffic (ISSUE 13): temperature > 0 exercises the fused
+    # device sampler end to end; bodies pin seed 0, so the consistency
+    # check still holds (counter-PRNG streams are deterministic per seed)
+    p.add_argument(
+        "--temperature", type=float, default=0.0,
+        help="request-body temperature (0 = greedy; > 0 drives the fused "
+        "device-sampled decode path with pinned seeds)",
+    )
+    p.add_argument(
+        "--topp", type=float, default=0.9,
+        help="request-body top_p for sampled (--temperature > 0) traffic",
+    )
+    p.add_argument(
+        "--topk", type=int, default=0,
+        help="request-body top_k for sampled traffic (0 = off)",
+    )
     # driving
     p.add_argument("--max-inflight", type=int, default=128)
     p.add_argument("--timeout-s", type=float, default=120.0)
@@ -172,6 +188,9 @@ def make_workload(args) -> workload.Workload:
         prefix_chars=args.prefix_chars,
         n_suffixes=args.suffixes,
         suffix_chars=args.suffix_chars,
+        temperature=args.temperature,
+        topp=args.topp,
+        topk=args.topk,
         tenants=workload.parse_tenant_loads(args.tenants),
     )
 
@@ -205,6 +224,7 @@ def main(argv=None) -> int:
             replicas=args.replicas,
             canary_interval_s=args.canary_interval_s,
             shadow_rate=args.shadow_rate,
+            topk=args.topk,
         )
         url = host.url
         print(f"self-hosted server at {url}", file=sys.stderr)

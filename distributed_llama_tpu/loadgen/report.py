@@ -76,6 +76,11 @@ SERVER_COUNTERS = (
     # the clean run's mismatch counter (zero false positives)
     "dllama_sdc_checks_total",
     "dllama_sdc_mismatches_total",
+    # device-resident sampling (ISSUE 13): the sampled-traffic smoke
+    # gates --expect-delta on device-sampled tokens and --expect-zero on
+    # the host-sampler fallback (the no-host-round-trip happy path)
+    "dllama_device_sampled_tokens_total",
+    "dllama_host_sampler_fallback_total",
 )
 
 

@@ -65,6 +65,7 @@ def start_selfhost(
     replicas: int = 1,
     canary_interval_s: float = 0.0,
     shadow_rate: float = 0.0,
+    topk: int = 0,
 ) -> SelfHost:
     """Build the tiny synthetic model + tokenizer, construct the real
     ApiState (batched decode, prefix cache, weighted-fair admission) and
@@ -95,11 +96,14 @@ def start_selfhost(
         spec, seed=seed,
     )
     engine = InferenceEngine(path, dtype=jnp.float32)
+    # counter mode (ISSUE 13): production shape — any host-sampled token is
+    # a counted fallback, and a host replay matches the device stream
     sampler = Sampler(
-        vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, seed=1
+        vocab_size=spec.vocab_size, temperature=0.0, topp=0.9, topk=topk,
+        seed=1, counter=True,
     )
     args = types.SimpleNamespace(
-        temperature=0.0, topp=0.9, seed=1, chat_template=None,
+        temperature=0.0, topp=0.9, topk=topk, seed=1, chat_template=None,
         parallel=parallel, batch_decode=True, decode="device",
         decode_chunk=decode_chunk, prefill_chunk=64,
         # tiered prefix cache (ISSUE 11): kv_pages deliberately tiny in

@@ -81,6 +81,14 @@ class Workload:
     prefix_chars: int = 48
     n_suffixes: int = 6
     suffix_chars: int = 12
+    # sampling shape (ISSUE 13): temperature > 0 drives the fused
+    # DEVICE-sampled path instead of greedy argmax. Bodies always pin
+    # seed 0, and the counter PRNG keys coins on (seed, position), so
+    # byte-identical sampled bodies still stream byte-identically — the
+    # survivor-consistency contract holds for sampled traffic too
+    temperature: float = 0.0
+    topp: float = 0.9
+    topk: int = 0
     tenants: list[TenantLoad] = dataclasses.field(
         default_factory=lambda: [TenantLoad("default")]
     )
@@ -218,11 +226,18 @@ def build_schedule(w: Workload) -> list[ScheduledRequest]:
                 {"role": "user", "content": suffixes[sid]},
             ],
             "max_tokens": tenant.max_tokens,
-            "temperature": 0.0,  # greedy: identical bodies MUST stream
-            "seed": 0,           # identically (the consistency contract)
+            # identical bodies MUST stream identically (the consistency
+            # contract): seed 0 pins the counter PRNG, so it holds for
+            # sampled (temperature > 0) traffic exactly as for greedy
+            "temperature": w.temperature,
+            "seed": 0,
             "stream": True,
             "tenant": tenant.name,
         }
+        if w.temperature > 0.0:
+            body["top_p"] = w.topp
+            if w.topk > 0:
+                body["top_k"] = w.topk
         if tenant.priority is not None:
             body["priority"] = tenant.priority
         if tenant.deadline_ms is not None:

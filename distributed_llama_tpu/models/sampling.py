@@ -7,10 +7,37 @@ dozens of ms, an order of magnitude more than the forward pass itself. Here
 the whole decode loop (forward → sample → feed back) runs under one
 ``lax.scan`` on device; the host dispatches once and fetches N tokens.
 
-Semantics match the host Sampler (greedy argmax / temperature softmax /
-top-p nucleus — reference: src/tokenizer.cpp:294-415) except the RNG:
-jax.random replaces the xorshift generator, so seeded runs are reproducible
-within this runtime but not bit-identical to the reference's draw sequence.
+Sampling is FUSED into the scan (ISSUE 13): temperature / top-k / top-p
+filtering and the categorical draw run per step on device, drawing coins
+from the counter-mode PRNG in :mod:`distributed_llama_tpu.prng`. The coin
+for the token drawn after consuming stream position ``p`` is a pure
+function of ``(request seed, p)`` — no sampler state exists, so:
+
+* a stream is bit-identical however the decode is chunked into dispatches;
+* PR 8/9's preemption-requeue and failover replays re-draw the exact coins
+  on any replica without shipping sampler state (positions are defined by
+  token content, not replica state);
+* the host ``Sampler``'s counter mode (tokenizer.py) replays the same
+  draws from fetched logits — the xorshift host-parity verification mode.
+
+Candidate semantics (shared with the host counter sampler, and the
+contract the parity suite asserts): candidates are ordered by descending
+temperature-scaled logit (ties broken by lower token id — ``lax.top_k``
+order); top-k keeps the first k; top-p keeps the nucleus prefix
+(token ``i`` stays while the mass strictly before it is < topp, the
+reference's inclusive-crossing rule, src/tokenizer.cpp:334-369); the draw
+is inverse-CDF over the kept prefix with one uniform coin. With both
+filters off the draw is inverse-CDF in vocab order (no sort — the
+multinomial path). All float math is f32. Host parity on the filtered
+paths rests on the f32 softmax (max-subtract, exp, full-vocab sum,
+divide) and the ≤``TOPP_FAST_K``-element kept-prefix cumsum reducing
+identically in numpy and XLA — measured exact on the CPU backend over
+thousands of draws, though a denominator or boundary value landing
+within 1 ulp of a coin/topp crossing can in principle flip a pick on
+another backend. The full-vocab cumsum paths (the multinomial draw and
+nuclei wider than the fast-path window) carry the larger version of the
+same caveat: XLA's parallel prefix sum may associate differently from a
+sequential host cumsum.
 """
 
 from __future__ import annotations
@@ -20,81 +47,197 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from distributed_llama_tpu import prng
 from distributed_llama_tpu.engine import integrity
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 
-
-def sample_token(
-    logits: jax.Array, key: jax.Array, temperature, topp
-) -> jax.Array:
-    """Sample one token id from f32 logits [vocab].
-
-    ``temperature``/``topp`` may be Python floats (static under jit — the
-    greedy/top-p branches specialize away) or traced scalars (the chunked
-    decode path, where one compiled program serves every request's sampler
-    settings)."""
-    if isinstance(temperature, jax.Array) or isinstance(topp, jax.Array):
-        return _sample_token_dynamic(logits, key, temperature, topp)
-    if temperature == 0.0:
-        return jnp.argmax(logits).astype(jnp.int32)
-    logits = logits / temperature
-    if 0.0 < topp < 1.0:
-        probs = jax.nn.softmax(logits)
-        threshold = _topp_threshold(probs, topp)
-        logits = jnp.where(probs >= threshold, logits, -jnp.inf)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
-
-
-# top-k width of the nucleus-threshold fast path: when the top-p mass sits
-# inside the largest TOPP_FAST_K probabilities (virtually always for
-# topp <= 0.95 on a trained model), the threshold comes from one top_k
-# instead of a full-vocab sort; a lax.cond falls back to the sort otherwise,
-# so the result is EXACT either way
+# width of the sorted-candidate fast path: when the kept prefix (top-k ∧
+# nucleus) provably fits in the largest TOPP_FAST_K candidates (virtually
+# always for topp <= 0.95 on a trained model), the pick runs on one top_k
+# instead of a full-vocab sort; a lax.cond falls back to the full sort
+# otherwise, so the result is EXACT either way
 TOPP_FAST_K = 128
 
 
-def _topp_threshold(probs: jax.Array, topp: jax.Array) -> jax.Array:
-    """The smallest probability inside the top-p nucleus (inclusive of the
-    crossing element, like the reference's last_idx logic,
-    src/tokenizer.cpp:334-369). Exact: the top-k fast path is used only
-    when the nucleus provably fits in the top k (prefix mass at rank i is
-    monotone, so no index >= k can be counted once cum[k-1] >= topp)."""
-    k = min(TOPP_FAST_K, probs.shape[-1])
-    top_vals, _ = jax.lax.top_k(probs, k)
-    cum_k = jnp.cumsum(top_vals)
-
-    def fast(_):
-        cutoff = jnp.sum(cum_k - top_vals < topp)
-        return top_vals[jnp.maximum(cutoff - 1, 0)]
-
-    def full(_):
-        sorted_probs = jnp.sort(probs)[::-1]
-        cum = jnp.cumsum(sorted_probs)
-        cutoff = jnp.sum(cum - sorted_probs < topp)
-        return sorted_probs[jnp.maximum(cutoff - 1, 0)]
-
-    if k == probs.shape[-1]:
-        return fast(None)
-    return jax.lax.cond(cum_k[-1] >= topp, fast, full, None)
+def _keep_count(vals, cum, topp, topk):
+    """Kept-prefix width over descending candidates [rows, K]: the
+    inclusive-crossing nucleus count (keep candidate i while the mass
+    strictly before it < topp) ∧ top-k, clipped to [1, K]. THE keep rule
+    of the host/device/spec parity contract — one definition shared by
+    the categorical pick and the speculative filtered distribution
+    (tokenizer.Sampler._sample_counter mirrors it in numpy)."""
+    K = vals.shape[-1]
+    topp = jnp.broadcast_to(jnp.asarray(topp, jnp.float32), vals.shape[:-1])
+    topk = jnp.broadcast_to(jnp.asarray(topk, jnp.int32), vals.shape[:-1])
+    topp_act = (topp > 0.0) & (topp < 1.0)
+    n_nuc = jnp.where(
+        topp_act, jnp.sum(cum - vals < topp[..., None], axis=-1), K
+    )
+    n_k = jnp.where(topk > 0, jnp.minimum(topk, K), K)
+    return jnp.clip(jnp.minimum(n_nuc, n_k), 1, K)
 
 
-def _sample_token_dynamic(
-    logits: jax.Array, key: jax.Array, temperature: jax.Array, topp: jax.Array
+def _pick_sorted(vals, idxs, coin, topp, topk):
+    """Inverse-CDF pick over descending candidates.
+
+    ``vals`` [B, K] candidate probabilities in canonical order (descending
+    scaled logit, ties by lower id), ``idxs`` [B, K] their token ids,
+    ``coin`` [B] uniforms, ``topp``/``topk`` [B] runtime filters. Keeps
+    the prefix ``min(top-k, nucleus)`` (:func:`_keep_count`) and draws
+    ``r = coin * kept_mass``; the pick is the first candidate whose
+    cumulative mass exceeds ``r`` — exactly the host counter sampler's
+    arithmetic, value for value."""
+    K = vals.shape[-1]
+    cum = jnp.cumsum(vals, axis=-1)
+    n_keep = _keep_count(vals, cum, topp, topk)
+    total = jnp.take_along_axis(cum, (n_keep - 1)[:, None], axis=-1)[:, 0]
+    r = coin * total
+    below = jnp.sum(
+        (jnp.arange(K)[None, :] < n_keep[:, None]) & (cum <= r[:, None]),
+        axis=-1,
+    )
+    pick = jnp.minimum(below, n_keep - 1)
+    return jnp.take_along_axis(idxs, pick[:, None], axis=-1)[:, 0]
+
+
+def fused_pick(probs, scaled, coin, topp, topk, cand=None):
+    """The filtered categorical pick on probabilities [B, V] (f32).
+
+    ``scaled`` are the temperature-scaled logits the canonical candidate
+    order sorts by (softmax is weakly monotone in f32, so sorting by
+    ``scaled`` and reading ``probs`` values keeps host and device on the
+    identical candidate sequence). ``cand`` [B, K] optionally supplies the
+    candidate ids already reduced over a sharded vocab
+    (:func:`sharded_topk_indices` — the tp composition); the full-vocab
+    sort fallback still runs on ``probs``/``scaled`` when the kept prefix
+    cannot be proven to fit. Rows with both filters inactive draw
+    inverse-CDF in vocab order (no sort)."""
+    B, V = probs.shape
+    K = min(TOPP_FAST_K, V)
+    topp_act = (topp > 0.0) & (topp < 1.0)
+    topk_act = (topk > 0) & (topk < V)
+    filt = topp_act | topk_act
+
+    # multinomial (no filter): vocab-order inverse CDF over the full mass.
+    # Behind a cond: the full-vocab cumsum only runs when some row actually
+    # has both filters off (never, in the filtered serving default)
+    def mult(_):
+        cdf = jnp.cumsum(probs, axis=-1)
+        r_m = coin * cdf[:, -1]
+        return jnp.minimum(
+            jnp.sum(cdf <= r_m[:, None], axis=-1), V - 1
+        ).astype(jnp.int32)
+
+    idx_m = jax.lax.cond(
+        jnp.any(~filt), mult, lambda _: jnp.zeros((B,), jnp.int32), None
+    )
+
+    def from_full(_):
+        fv, fi = jax.lax.top_k(scaled, V)
+        return _pick_sorted(
+            jnp.take_along_axis(probs, fi, axis=-1), fi, coin, topp, topk
+        )
+
+    if cand is not None:
+        idxs = cand
+        vals = jnp.take_along_axis(probs, idxs, axis=-1)
+    elif K == V:
+        fi = jax.lax.top_k(scaled, V)[1]
+        idxs, vals = fi, jnp.take_along_axis(probs, fi, axis=-1)
+    else:
+        idxs = jax.lax.top_k(scaled, K)[1]
+        vals = jnp.take_along_axis(probs, idxs, axis=-1)
+    if cand is None and K == V:
+        tok_f = _pick_sorted(vals, idxs, coin, topp, topk)
+    else:
+        # the fast window is exact unless a row's kept prefix could extend
+        # past it. An overflowing NUCLEUS alone does not force the full
+        # sort when an in-window top-k also binds: the nucleus count is
+        # then provably > window >= topk, so min(nucleus, topk) = topk and
+        # the window has every kept candidate (_pick_sorted's counting
+        # saturates at the window, which is exactly right). Only a nucleus
+        # overflowing with no in-window top-k, or a top-k wider than the
+        # window, needs the full order.
+        Kw = vals.shape[-1]
+        cum_k = jnp.cumsum(vals, axis=-1)
+        nucleus_unfit = topp_act & (cum_k[:, -1] < topp)
+        wide_topk = topk_act & (topk > Kw)
+        narrow_topk = topk_act & (topk <= Kw)
+        need_full = (nucleus_unfit & ~narrow_topk) | (~topp_act & wide_topk)
+        tok_f = jax.lax.cond(
+            jnp.any(need_full),
+            from_full,
+            lambda _: _pick_sorted(vals, idxs, coin, topp, topk),
+            None,
+        )
+    return jnp.where(filt, tok_f, idx_m)
+
+
+def fused_sample_batched(
+    logits,  # [B, vocab]
+    seeds,  # uint32 [B] (prng.fold_seed on the host)
+    pos,  # int32 [B] — position of the token each row just consumed
+    temperature,  # [B]
+    topp,  # [B]
+    topk,  # int32 [B] (0 = off)
+    draw: int = prng.DRAW_SAMPLE,
+    cand=None,
 ) -> jax.Array:
-    """Same semantics with runtime-valued temperature/topp: the greedy and
-    top-p decisions become ``jnp.where`` selects. Draw-identical to the static
-    path for the same key (the filtered-logit construction matches — the
-    fast-path threshold equals the full-sort threshold exactly), so chunked
-    and single-dispatch decode produce the same stream per seed."""
-    scaled = logits / jnp.maximum(temperature, 1e-6)
-    probs = jax.nn.softmax(scaled)
-    threshold = _topp_threshold(probs, topp)
-    use_topp = (topp > 0.0) & (topp < 1.0)
-    filtered = jnp.where(use_topp & (probs < threshold), -jnp.inf, scaled)
-    sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
-    greedy = jnp.argmax(logits).astype(jnp.int32)
-    return jnp.where(temperature == 0.0, greedy, sampled)
+    """Fused temperature/top-k/top-p sampling with the counter PRNG:
+    one coin per row keyed ``(seed, pos, draw)``, greedy rows
+    (``temperature == 0``) take the exact raw-logits argmax — bit-identical
+    to a pure-greedy dispatch, coins never consumed."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(scaled, axis=-1)
+    coin = prng.device_coin(seeds, pos, draw)
+    tok = fused_pick(probs, scaled, coin, topp, topk, cand=cand)
+    return jnp.where(temperature == 0.0, greedy, tok.astype(jnp.int32))
+
+
+def sample_token(
+    logits, seed, pos, temperature, topp, topk=0
+) -> jax.Array:
+    """Sample one token id from f32 logits [vocab] with the fused sampler.
+
+    ``temperature``/``topp``/``topk`` may be Python scalars (static under
+    jit — a greedy call specializes to a bare argmax) or traced values
+    (one compiled program serves every request's sampler settings).
+    ``seed`` is the folded uint32 word; ``pos`` the consumed position the
+    coin is keyed on."""
+    static = not any(
+        isinstance(v, jax.Array) for v in (temperature, topp, topk)
+    )
+    if static and temperature == 0.0:
+        return jnp.argmax(logits).astype(jnp.int32)
+    return fused_sample_batched(
+        logits[None],
+        jnp.asarray(seed, jnp.uint32)[None],
+        jnp.asarray(pos, jnp.int32)[None],
+        jnp.asarray(temperature, jnp.float32)[None],
+        jnp.asarray(topp, jnp.float32)[None],
+        jnp.asarray(topk, jnp.int32)[None],
+    )[0]
+
+
+def sharded_topk_indices(local_logits, axis_name, k: int):
+    """Global top-k token ids composed over a vocab-sharded logits head:
+    per-shard ``top_k`` on the LOCAL slice, ONE [B, k]-candidate
+    all-gather, and a merge ``top_k`` — the full-vocab sort never runs,
+    and only k·tp candidate words ride the collective instead of the
+    whole vocabulary. Exactly equal to ``top_k`` over the gathered vocab:
+    selection commutes with concatenation, and ties resolve to the lower
+    global id on both (shard-major gather order == global id order)."""
+    B, vs = local_logits.shape
+    kl = min(k, vs)
+    lv, li = jax.lax.top_k(local_logits, kl)
+    gi = li + jax.lax.axis_index(axis_name) * vs
+    av = jax.lax.all_gather(lv, axis_name, axis=1, tiled=True)  # [B, tp*kl]
+    ai = jax.lax.all_gather(gi, axis_name, axis=1, tiled=True)
+    mi = jax.lax.top_k(av, min(k, av.shape[1]))[1]
+    return jnp.take_along_axis(ai, mi, axis=1)
 
 
 def decode_scan(
@@ -103,44 +246,56 @@ def decode_scan(
     first_token: jax.Array,  # int32 scalar
     cache: jax.Array,
     pos: jax.Array,  # int32 scalar: position of first_token
-    key: jax.Array,
+    seed: jax.Array,  # uint32 scalar (prng.fold_seed on the host)
     n_steps: int,
-    temperature: float,
-    topp: float,
+    temperature,
+    topp,
+    topk=0,
     axis_name: str | None = None,
 ):
-    """The un-jitted decode scan body: forward → sample → feed back.
-    Returns (tokens [n_steps], cache, advanced key) — threading the returned
-    key into the next call makes the token stream independent of how the
-    decode is chunked into dispatches.
+    """The un-jitted decode scan body: forward → fused sample → feed back.
+    Returns (tokens [n_steps], cache). Coins are keyed on the absolute
+    position each step consumes, so the token stream is independent of how
+    the decode is chunked into dispatches — no sampler state threads
+    between calls.
 
     With ``axis_name`` set it is the per-shard SPMD body for a shard_map'd
-    tensor-parallel decode: the forward psums ride the mesh, a vocab-sharded
-    logits head is all-gathered, and sampling runs identically on every
-    shard (same key → same token everywhere).
+    tensor-parallel decode: the forward psums ride the mesh, a
+    vocab-sharded logits head is all-gathered, and sampling runs
+    identically on every shard (same counter → same token everywhere).
     """
 
     def step(carry, _):
-        token, cache, p, k = carry
+        token, cache, p = carry
         logits, cache = llama.forward_tokens(
             cfg, params, token[None], cache, p, axis_name=axis_name
         )
         if axis_name is not None and logits.shape[-1] != cfg.vocab_size:
             logits = jax.lax.all_gather(logits, axis_name, axis=1, tiled=True)
-        k, sub = jax.random.split(k)
-        nxt = sample_token(logits[0], sub, temperature, topp)
-        return (nxt, cache, p + 1, k), nxt
+        nxt = sample_token(logits[0], seed, p, temperature, topp, topk)
+        return (nxt, cache, p + 1), nxt
 
-    (_, cache, _, key), tokens = jax.lax.scan(
-        step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32), key), None,
+    (_, cache, _), tokens = jax.lax.scan(
+        step,
+        (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32)),
+        None,
         length=n_steps,
     )
-    return tokens, cache, key
+    return tokens, cache
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 5, 6, 7), donate_argnums=(3,)
+    jax.jit, static_argnums=(0, 6, 7, 8, 9), donate_argnums=(3,)
 )
+def _decode_loop_jit(
+    cfg, params, first_token, cache, pos, seed, n_steps, temperature, topp, topk
+):
+    return decode_scan(
+        cfg, params, first_token, cache, pos, seed, n_steps, temperature,
+        topp, topk,
+    )
+
+
 def decode_loop(
     cfg: LlamaConfig,
     params,
@@ -150,32 +305,22 @@ def decode_loop(
     n_steps: int,
     temperature: float,
     topp: float,
-    key: jax.Array | None = None,
+    seed: int = 0,
+    topk: int = 0,
 ):
     """Generate ``n_steps`` tokens autoregressively on device (single chip).
 
     Returns (tokens [n_steps] int32, final cache). tokens[i] is the token
-    sampled after consuming the token at position pos+i.
+    sampled after consuming the token at position pos+i. Sampler settings
+    are static here (the greedy program specializes to a bare argmax);
+    the chunked serving path uses :func:`decode_chunk` instead.
     """
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    tokens, cache, _ = decode_scan(
-        cfg, params, first_token, cache, pos, key, n_steps, temperature, topp
+    tokens, cache = _decode_loop_jit(
+        cfg, params, jnp.asarray(first_token), cache, jnp.asarray(pos),
+        jnp.uint32(prng.fold_seed(seed)), int(n_steps), float(temperature),
+        float(topp), int(topk),
     )
     return tokens, cache
-
-
-def sample_tokens_batched(
-    logits: jax.Array,  # [B, vocab] f32
-    keys: jax.Array,  # [B, 2] per-row PRNG keys
-    temperature: jax.Array,  # [B]
-    topp: jax.Array,  # [B]
-) -> jax.Array:
-    """Per-row sampling with per-row keys/settings: a vmap of the dynamic
-    single-row sampler, so row ``b`` draws EXACTLY what a single-stream
-    chunk with the same key would (vmap preserves per-row semantics — the
-    bit-parity contract of the batched decode)."""
-    return jax.vmap(_sample_token_dynamic)(logits, keys, temperature, topp)
 
 
 def batched_decode_scan(
@@ -185,28 +330,35 @@ def batched_decode_scan(
     cache,  # slab cache (llama.init_batch_cache)
     pos: jax.Array,  # int32 [B] per-row positions of first_tokens
     active: jax.Array,  # bool [B]
-    keys: jax.Array,  # [B, 2] per-row PRNG keys
+    seeds: jax.Array,  # uint32 [B] per-row folded request seeds
     n_steps: int,
     temperature: jax.Array,  # [B]
     topp: jax.Array,  # [B]
+    topk: jax.Array,  # int32 [B]
     axis_name: str | None = None,
     paged=None,  # (pool, tables, matched) — zero-copy prefix aliasing
     fingerprint: bool = True,
 ):
     """The batched decode body: B sequences step together, each weight
     matrix read once per step. Per row it is the same forward → split →
-    sample → feed-back chain as :func:`decode_scan`, with the SAME
-    key-splitting order, so a row's token stream is identical to the
-    single-stream chunked decode for the same per-row key. Inactive rows
+    sample → feed-back chain as :func:`decode_scan` with the SAME
+    position-keyed coins, so a row's token stream is identical to the
+    single-stream chunked decode for the same request seed. Inactive rows
     compute garbage (masked out of cache writes and position advances) so
     requests can join/leave between chunks without a recompile. Returns
-    (tokens [n_steps, B], cache, advanced keys [B, 2], fingerprints
-    uint32 [B], finite bool [B]). ``paged``: each row's matched prompt
-    prefix is read from the shared page pool through its page table
-    instead of the slab (the pool rides the scan as a read-only closure
-    capture — no copy, no donation).
+    (tokens [n_steps, B], cache, fingerprints uint32 [B], finite bool
+    [B]) — NOTHING else needs to cross the host per chunk: the sampler is
+    stateless, so no advanced keys return and no full-vocab logits are
+    ever fetched. ``paged``: each row's matched prompt prefix is read from
+    the shared page pool through its page table instead of the slab (the
+    pool rides the scan as a read-only closure capture — no copy, no
+    donation).
 
-    ``fingerprint`` folds each step's per-row logit sum + token into an
+    Under a vocab-sharded tp head the candidate top-k is composed over the
+    shards (:func:`sharded_topk_indices`) before the logits all-gather
+    that the fingerprint fold needs.
+
+    ``fingerprint`` folds each step's per-row logit argmax + token into an
     FNV-1a hash and a finiteness flag ON DEVICE (engine/integrity.py —
     the SDC detection substrate, ISSUE 10); the sampling itself is
     untouched, so the token stream is bit-identical either way.
@@ -214,32 +366,39 @@ def batched_decode_scan(
     hashes) — the overhead-bound test compiles both and compares."""
 
     def step(carry, _):
-        tokens, cache_c, p, ks, h, okf = carry
+        tokens, cache_c, p, h, okf = carry
         logits, cache_c = llama.forward_step_batched(
             cfg, params, tokens, cache_c, p, active, axis_name=axis_name,
             paged=paged,
         )
+        cand = None
         if axis_name is not None and logits.shape[-1] != cfg.vocab_size:
+            # the tp top-k composition: candidates reduce over the sharded
+            # vocab BEFORE the full gather (selection by raw logits —
+            # temperature scaling is order-preserving)
+            cand = sharded_topk_indices(
+                logits, axis_name, min(TOPP_FAST_K, cfg.vocab_size)
+            )
             logits = jax.lax.all_gather(logits, axis_name, axis=1, tiled=True)
-        split = jax.vmap(jax.random.split)(ks)  # [B, 2, 2]
-        ks2, subs = split[:, 0], split[:, 1]
-        nxt = sample_tokens_batched(logits, subs, temperature, topp)
+        nxt = fused_sample_batched(
+            logits, seeds, p, temperature, topp, topk, cand=cand
+        )
         if fingerprint:
             h, okf = integrity.fingerprint_fold(h, okf, logits, nxt)
         p2 = jnp.where(active, p + 1, p)
-        return (nxt.astype(jnp.int32), cache_c, p2, ks2, h, okf), nxt
+        return (nxt.astype(jnp.int32), cache_c, p2, h, okf), nxt
 
     h0, ok0 = integrity.fingerprint_init(first_tokens.shape[0])
-    (_, cache, _, keys, h, okf), tokens = jax.lax.scan(
+    (_, cache, _, h, okf), tokens = jax.lax.scan(
         step,
         (
             first_tokens.astype(jnp.int32), cache, pos.astype(jnp.int32),
-            keys, h0, ok0,
+            h0, ok0,
         ),
         None,
         length=n_steps,
     )
-    return tokens, cache, keys, h, okf
+    return tokens, cache, h, okf
 
 
 @functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(3,))
@@ -253,24 +412,26 @@ def decode_chunk_batched(
     n_steps: int,
     temperature: jax.Array,
     topp: jax.Array,
-    keys: jax.Array,
+    topk: jax.Array,
+    seeds: jax.Array,
 ):
     """One chunk of the batched multi-stream decode (single chip): like
     :func:`decode_chunk` but over B concurrent sequences with per-row
-    positions, sampler settings and PRNG keys — one compiled program per
+    positions, sampler settings and seeds — one compiled program per
     (bucket, chunk) shape serves every mix of requests. The slab cache is
-    donated and aliases in place; advanced per-row keys return so each
-    stream continues exactly as its single-stream chunked decode would.
+    donated and aliases in place; no sampler state returns — the next
+    chunk re-keys its coins from (seed, position).
 
-    Returns ``(out, cache, keys)`` where ``out`` is the packed
-    [n_steps + 2, B] int32 bundle of tokens + per-row logit fingerprint +
-    finiteness flag (engine/integrity.py ``split_chunk_outputs``) — one
-    fetch still moves everything the scheduler needs."""
-    tokens, cache, keys, h, okf = batched_decode_scan(
-        cfg, params, first_tokens, cache, pos, active, keys, n_steps,
-        temperature, topp,
+    Returns ``(out, cache)`` where ``out`` is the packed [n_steps + 2, B]
+    int32 bundle of tokens + per-row logit fingerprint + finiteness flag
+    (engine/integrity.py ``split_chunk_outputs``) — one fetch still moves
+    everything the scheduler needs, and those int32 rows are the ONLY
+    bytes that cross the host per chunk."""
+    tokens, cache, h, okf = batched_decode_scan(
+        cfg, params, first_tokens, cache, pos, active, seeds, n_steps,
+        temperature, topp, topk,
     )
-    return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
+    return integrity.pack_chunk_outputs(tokens, h, okf), cache
 
 
 @functools.partial(jax.jit, static_argnums=(0, 7), donate_argnums=(3,))
@@ -285,7 +446,8 @@ def decode_chunk_batched_paged(
     n_steps: int,
     temperature: jax.Array,
     topp: jax.Array,
-    keys: jax.Array,
+    topk: jax.Array,
+    seeds: jax.Array,
     tables: jax.Array,  # int32 [B, n_table] per-row page tables
     matched: jax.Array,  # int32 [B] aliased prefix lengths (0 = no alias)
 ):
@@ -295,11 +457,11 @@ def decode_chunk_batched_paged(
     Only the slab is donated; the pool is shared across every row and
     dispatch, so it must never alias. Same packed [n_steps + 2, B] return
     bundle as :func:`decode_chunk_batched`."""
-    tokens, cache, keys, h, okf = batched_decode_scan(
-        cfg, params, first_tokens, cache, pos, active, keys, n_steps,
-        temperature, topp, paged=(pool, tables, matched),
+    tokens, cache, h, okf = batched_decode_scan(
+        cfg, params, first_tokens, cache, pos, active, seeds, n_steps,
+        temperature, topp, topk, paged=(pool, tables, matched),
     )
-    return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
+    return integrity.pack_chunk_outputs(tokens, h, okf), cache
 
 
 # ---------------------------------------------------------------------------
@@ -312,63 +474,104 @@ def decode_chunk_batched_paged(
 # ---------------------------------------------------------------------------
 
 
-def _spec_accept_row(logits, draft, draft_len, key, temperature, topp):
+def _filtered_dist(logits, temperature, topp, topk):
+    """The renormalized filtered distribution p [T, vocab] the spec
+    accept/redraw draws from: the SAME candidate semantics as the fused
+    sampler (descending scaled-logit order, top-k ∧ nucleus prefix),
+    expressed as a mask + renormalize so per-token acceptance
+    probabilities exist. Returns (p, greedy_targets)."""
+    T, vocab = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    probs = jax.vmap(jax.nn.softmax)(scaled)
+    sv_i = jax.lax.top_k(scaled, vocab)[1]  # [T, V] descending order
+    pv = jnp.take_along_axis(probs, sv_i, axis=-1)
+    cum = jnp.cumsum(pv, axis=-1)
+    n_keep = _keep_count(pv, cum, topp, topk)
+
+    def row_rank(order_row):
+        return (
+            jnp.zeros((vocab,), jnp.int32)
+            .at[order_row]
+            .set(jnp.arange(vocab, dtype=jnp.int32))
+        )
+
+    ranks = jax.vmap(row_rank)(sv_i)
+    keep = ranks < n_keep[:, None]
+    filt = jnp.where(keep, probs, 0.0)
+    p = filt / jnp.sum(filt, axis=-1, keepdims=True)
+    return p, greedy_targets
+
+
+def _cdf_pick(p, coin):
+    """Vocab-order inverse-CDF draw from per-row distributions ``p``
+    [T, vocab] with per-row coins [T] (mass renormalized by the row
+    total, so zeroed entries never draw)."""
+    vocab = p.shape[-1]
+    cdf = jnp.cumsum(p, axis=-1)
+    r = coin * cdf[:, -1]
+    return jnp.minimum(jnp.sum(cdf <= r[:, None], axis=-1), vocab - 1).astype(
+        jnp.int32
+    )
+
+
+def _spec_accept_row(logits, draft, draft_len, seed, pos, temperature, topp, topk):
     """Accept/reject one row's draft against its verify logits.
 
     ``logits``: [T, vocab] f32 (T = k + 1) — ``logits[i]`` is the model's
-    next-token distribution after consuming feed position ``i``;
-    ``draft``: [k] int32 (entries at or beyond ``draft_len`` are pad);
-    ``temperature``/``topp``: traced scalars. Returns
-    ``(n_emit, tokens [T], new_key)`` where ``tokens[:n_emit]`` are the
-    emitted tokens — ``n_emit - 1`` accepted drafts plus one
-    correction/bonus token drawn from the model's own distribution.
+    next-token distribution after consuming feed position ``i`` (absolute
+    stream position ``pos + i``); ``draft``: [k] int32 (entries at or
+    beyond ``draft_len`` are pad). Returns ``(n_emit, tokens [T])`` where
+    ``tokens[:n_emit]`` are the emitted tokens — ``n_emit - 1`` accepted
+    drafts plus one correction/bonus token drawn from the model's own
+    distribution.
 
     Greedy (temperature == 0): longest-matching-prefix against the argmax
     targets — every emitted token IS the plain decode's argmax at its
     position, so the stream is bit-identical to non-speculative decode.
 
-    Sampled: Leviathan-style rejection sampling. The prompt-lookup draft
-    distribution is the point mass q = δ(draft_i), so position i accepts
-    with probability p_i(draft_i) (p = the post-temperature/top-p filtered
-    softmax — exactly what :func:`_sample_token_dynamic` samples from) and
-    a rejection redraws from the residual norm(max(p - q, 0)) = p with
-    draft_i removed; acceptance never biases the output distribution."""
+    Sampled: Leviathan-style rejection sampling on counter coins. The
+    prompt-lookup draft distribution is the point mass q = δ(draft_i), so
+    position i accepts with probability p_i(draft_i) against the coin
+    keyed ``(seed, pos + i, DRAW_SPEC_ACCEPT)`` (p = the renormalized
+    top-k/top-p-filtered softmax — exactly what the fused sampler draws
+    from) and a rejection redraws from the residual norm(max(p - q, 0)) =
+    p with draft_i removed on the ``DRAW_SPEC_REDRAW`` coin of the emit
+    position; acceptance never biases the output distribution, and the
+    whole step consumes no sampler state — a replay re-keys every coin."""
     T, vocab = logits.shape
     k = T - 1
-    greedy_targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [T]
-    # the filtered target distribution, constructed identically to
-    # _sample_token_dynamic (fast-path threshold == full-sort threshold)
-    scaled = logits / jnp.maximum(temperature, 1e-6)
-    probs = jax.vmap(jax.nn.softmax)(scaled)  # [T, vocab]
-    thresholds = jax.vmap(_topp_threshold, in_axes=(0, None))(probs, topp)
-    use_topp = (topp > 0.0) & (topp < 1.0)
-    filtered = jnp.where(use_topp & (probs < thresholds[:, None]), -jnp.inf, scaled)
-    p = jax.vmap(jax.nn.softmax)(filtered)  # [T, vocab] — renormalized
+    p, greedy_targets = _filtered_dist(logits, temperature, topp, topk)
 
-    split = jax.random.split(key, 2 * T + 1)
-    new_key, u_keys, draw_keys = split[0], split[1 : T + 1], split[T + 1 :]
+    steps = pos + jnp.arange(T, dtype=jnp.int32)
+    u = prng.device_coin(
+        jnp.broadcast_to(seed, (T,)), steps, prng.DRAW_SPEC_ACCEPT
+    )
+    redraw = prng.device_coin(
+        jnp.broadcast_to(seed, (T,)), steps, prng.DRAW_SPEC_REDRAW
+    )
 
     i_idx = jnp.arange(k)
     in_draft = i_idx < draft_len
     p_draft = p[i_idx, draft]  # [k] acceptance probability per position
-    u = jax.vmap(jax.random.uniform)(u_keys[:k]) if k else jnp.zeros((0,))
-    sampled_ok = u < p_draft
+    sampled_ok = u[:k] < p_draft if k else jnp.zeros((0,), bool)
     greedy_ok = draft == greedy_targets[:k]
     ok = jnp.where(temperature == 0.0, greedy_ok, sampled_ok) & in_draft
     acc = jnp.cumprod(ok.astype(jnp.int32)) if k else jnp.zeros((0,), jnp.int32)
     n_acc = jnp.sum(acc)  # accepted draft prefix length
 
-    # one categorical per position (T is small): the residual draw for a
-    # rejection at i < draft_len, the full draw for the bonus position
-    resid_logits = jnp.where(
-        jnp.arange(vocab)[None, :] == draft[:, None], -jnp.inf, filtered[:k]
-    )
-    resid = (
-        jax.vmap(jax.random.categorical)(draw_keys[:k], resid_logits).astype(jnp.int32)
-        if k
-        else jnp.zeros((0,), jnp.int32)
-    )
-    full = jax.vmap(jax.random.categorical)(draw_keys, filtered).astype(jnp.int32)
+    # one inverse-CDF draw per position (T is small): the residual draw
+    # for a rejection at i < draft_len, the full draw for the bonus
+    # position — both on the emit position's redraw coin
+    if k:
+        q = jnp.where(
+            jnp.arange(vocab)[None, :] == draft[:, None], 0.0, p[:k]
+        )
+        resid = _cdf_pick(q, redraw[:k])
+    else:
+        resid = jnp.zeros((0,), jnp.int32)
+    full = _cdf_pick(p, redraw)
     resid_padded = jnp.concatenate([resid, jnp.zeros((1,), jnp.int32)])
     rejected = n_acc < draft_len
     corr_sampled = jnp.where(rejected, resid_padded[n_acc], full[n_acc])
@@ -378,7 +581,7 @@ def _spec_accept_row(logits, draft, draft_len, key, temperature, topp):
     draft_padded = jnp.concatenate([draft, jnp.zeros((1,), jnp.int32)])
     tokens = jnp.where(t_idx < n_acc, draft_padded, 0)
     tokens = jnp.where(t_idx == n_acc, corr, tokens).astype(jnp.int32)
-    return (n_acc + 1).astype(jnp.int32), tokens, new_key
+    return (n_acc + 1).astype(jnp.int32), tokens
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -391,21 +594,22 @@ def spec_verify_step(
     draft_len: jax.Array,  # int32 scalar
     temperature: jax.Array,
     topp: jax.Array,
-    key: jax.Array,
+    topk: jax.Array,
+    seed: jax.Array,  # uint32 scalar
 ):
     """One single-stream speculative step: verify forward (the ordinary
     multi-token decode at a position offset — ONE weight read for draft +
     bonus positions) fused with the on-device accept/reject. Returns
-    ``(out, cache, key)`` with ``out = [n_emit, tokens...]`` int32 [T+1] —
+    ``(out, cache)`` with ``out = [n_emit, tokens...]`` int32 [T+1] —
     the only bytes that visit the host. Cache slots past the accepted
     prefix hold rejected-draft K/V: stale but unreachable (the next step
     writes at the advanced position before any query can see them — the
     same overshoot contract as the chunked decode's rollback)."""
     logits, cache = llama.forward_tokens(cfg, params, feed, cache, pos)
-    n_emit, tokens, key = _spec_accept_row(
-        logits, feed[1:], draft_len, key, temperature, topp
+    n_emit, tokens = _spec_accept_row(
+        logits, feed[1:], draft_len, seed, pos, temperature, topp, topk
     )
-    return jnp.concatenate([n_emit[None], tokens]), cache, key
+    return jnp.concatenate([n_emit[None], tokens]), cache
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -419,22 +623,23 @@ def spec_verify_chunk_batched(
     draft_len: jax.Array,  # int32 [B]
     temperature: jax.Array,  # [B]
     topp: jax.Array,  # [B]
-    keys: jax.Array,  # [B, 2]
+    topk: jax.Array,  # int32 [B]
+    seeds: jax.Array,  # uint32 [B]
 ):
     """One batched speculative step: every joined row's verify window rides
     ONE weight read (llama.forward_verify_batched) and the per-row
-    accept/reject runs on device. Returns ``(out [B, T+1], cache,
-    new_keys)`` with ``out[b] = [n_emit_b, tokens_b...]`` — rows advance a
-    VARIABLE number of positions per step (the scheduler applies each
-    row's n_emit at fetch time). Inactive rows compute garbage into
-    dropped cache slots, exactly like the plain batched chunk."""
+    accept/reject runs on device. Returns ``(out [B, T+1], cache)`` with
+    ``out[b] = [n_emit_b, tokens_b...]`` — rows advance a VARIABLE number
+    of positions per step (the scheduler applies each row's n_emit at
+    fetch time). Inactive rows compute garbage into dropped cache slots,
+    exactly like the plain batched chunk."""
     logits, cache = llama.forward_verify_batched(
         cfg, params, feed, cache, pos, active
     )
-    n_emit, tokens, new_keys = jax.vmap(_spec_accept_row)(
-        logits, feed[:, 1:], draft_len, keys, temperature, topp
+    n_emit, tokens = jax.vmap(_spec_accept_row)(
+        logits, feed[:, 1:], draft_len, seeds, pos, temperature, topp, topk
     )
-    return jnp.concatenate([n_emit[:, None], tokens], axis=1), cache, new_keys
+    return jnp.concatenate([n_emit[:, None], tokens], axis=1), cache
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -449,7 +654,8 @@ def spec_verify_chunk_batched_paged(
     draft_len: jax.Array,  # int32 [B]
     temperature: jax.Array,  # [B]
     topp: jax.Array,  # [B]
-    keys: jax.Array,  # [B, 2]
+    topk: jax.Array,  # int32 [B]
+    seeds: jax.Array,  # uint32 [B]
     tables: jax.Array,  # int32 [B, n_table]
     matched: jax.Array,  # int32 [B]
 ):
@@ -460,10 +666,10 @@ def spec_verify_chunk_batched_paged(
     logits, cache = llama.forward_verify_batched(
         cfg, params, feed, cache, pos, active, paged=(pool, tables, matched)
     )
-    n_emit, tokens, new_keys = jax.vmap(_spec_accept_row)(
-        logits, feed[:, 1:], draft_len, keys, temperature, topp
+    n_emit, tokens = jax.vmap(_spec_accept_row)(
+        logits, feed[:, 1:], draft_len, seeds, pos, temperature, topp, topk
     )
-    return jnp.concatenate([n_emit[:, None], tokens], axis=1), cache, new_keys
+    return jnp.concatenate([n_emit[:, None], tokens], axis=1), cache
 
 
 @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
@@ -476,13 +682,16 @@ def decode_chunk(
     n_steps: int,
     temperature: jax.Array,
     topp: jax.Array,
-    key: jax.Array,
+    topk: jax.Array,
+    seed: jax.Array,  # uint32 scalar
 ):
     """One chunk of the user-facing streaming decode (single chip): like
-    :func:`decode_loop` but temperature/topp are *traced* scalars — one
-    compiled program per chunk size serves every request's sampler settings —
-    and the advanced PRNG key is returned so the stream continues across
-    chunks exactly as a single dispatch would."""
+    :func:`decode_loop` but temperature/topp/topk are *traced* scalars —
+    one compiled program per chunk size serves every request's sampler
+    settings — and coins re-key per position, so the stream continues
+    across chunks exactly as a single dispatch would with no state
+    returned."""
     return decode_scan(
-        cfg, params, first_token, cache, pos, key, n_steps, temperature, topp
+        cfg, params, first_token, cache, pos, seed, n_steps, temperature,
+        topp, topk,
     )

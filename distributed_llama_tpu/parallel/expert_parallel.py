@@ -396,59 +396,75 @@ class ExpertParallelForward(TransferProbeMixin):
     def forward(self, params, tokens, cache, pos):
         return self._jitted(params, jnp.asarray(tokens), cache, jnp.asarray(pos))
 
-    def decode_loop(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
-        tokens, cache, _ = self._decode_scan(int(n_steps), float(temperature), float(topp))(
-            params, jnp.asarray(first_token), cache, jnp.asarray(pos), key
+    def decode_loop(
+        self, params, first_token, cache, pos, n_steps, temperature, topp,
+        seed: int = 0, topk: int = 0,
+    ):
+        from distributed_llama_tpu import prng
+
+        tokens, cache = self._decode_scan(
+            int(n_steps), float(temperature), float(topp), int(topk)
+        )(
+            params, jnp.asarray(first_token), cache, jnp.asarray(pos),
+            jnp.uint32(prng.fold_seed(seed)),
         )
         return tokens, cache
 
-    def decode_chunk(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
-        jitted = self._decode_scan(int(n_steps), None, None)
+    def decode_chunk(
+        self, params, first_token, cache, pos, n_steps, temperature, topp,
+        topk, seed32,
+    ):
+        jitted = self._decode_scan(int(n_steps), None, None, None)
         return jitted(
             params, jnp.asarray(first_token), cache, jnp.asarray(pos),
-            jnp.float32(temperature), jnp.float32(topp), key,
+            jnp.float32(temperature), jnp.float32(topp), jnp.int32(topk),
+            jnp.asarray(seed32, jnp.uint32),
         )
 
-    def _decode_scan(self, n_steps: int, temperature, topp):
+    def _decode_scan(self, n_steps: int, temperature, topp, topk):
         from distributed_llama_tpu.models import sampling
 
         P = self._P
-        key_ = (n_steps, temperature, topp)
+        key_ = (n_steps, temperature, topp, topk)
         cached = self._decode_cache.get(key_)
         if cached is not None:
             return cached
         cfg = self.cfg
         tp_axis = self._tp_axis
 
-        def scan_body(params, first_token, cache, pos, key, t, p):
+        def scan_body(params, first_token, cache, pos, seed, t, p, k_top):
             def step(carry, _):
-                token, cache_c, pp, k = carry
+                token, cache_c, pp = carry
                 logits, cache_c = _ep_forward(cfg, tp_axis, params, token[None], cache_c, pp)
-                k, sub = jax.random.split(k)
-                nxt = sampling.sample_token(logits[0], sub, t, p)
-                return (nxt, cache_c, pp + 1, k), nxt
+                nxt = sampling.sample_token(logits[0], seed, pp, t, p, k_top)
+                return (nxt, cache_c, pp + 1), nxt
 
-            (_, cache, _, key), tokens = jax.lax.scan(
-                step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32), key),
+            (_, cache, _), tokens = jax.lax.scan(
+                step, (first_token.astype(jnp.int32), cache, pos.astype(jnp.int32)),
                 None, length=n_steps,
             )
-            return tokens, cache, key
+            return tokens, cache
 
         if temperature is None:
 
-            def fn(params, first_token, cache, pos, t_in, p_in, key):
-                return scan_body(params, first_token, cache, pos, key, t_in, p_in)
+            def fn(params, first_token, cache, pos, t_in, p_in, k_in, seed):
+                return scan_body(
+                    params, first_token, cache, pos, seed, t_in, p_in, k_in
+                )
 
-            in_specs = (self._specs, P(), self._cache_spec, P(), P(), P(), P())
+            in_specs = (self._specs, P(), self._cache_spec, P(), P(), P(), P(), P())
         else:
 
-            def fn(params, first_token, cache, pos, key):
-                return scan_body(params, first_token, cache, pos, key, temperature, topp)
+            def fn(params, first_token, cache, pos, seed):
+                return scan_body(
+                    params, first_token, cache, pos, seed, temperature, topp,
+                    topk,
+                )
 
             in_specs = (self._specs, P(), self._cache_spec, P(), P())
         mapped = self._shard_map(
             fn, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(P(), self._cache_spec, P()), check_vma=False,
+            out_specs=(P(), self._cache_spec), check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
         self._decode_cache[key_] = jitted

@@ -437,40 +437,51 @@ class TensorParallelForward(TransferProbeMixin):
         out["layers"] = layers
         return out
 
-    def _decode_jitted(self, n_steps: int, temperature: float, topp: float):
+    def _decode_jitted(self, n_steps: int, temperature: float, topp: float, topk: int):
         # per-instance cache (an lru_cache on the method would pin self and
         # its compiled executables in a class-level cache for process life)
-        key = (n_steps, temperature, topp)
+        key = (n_steps, temperature, topp, topk)
         cached = self._decode_cache.get(key)
         if cached is not None:
             return cached
         from distributed_llama_tpu.models import sampling
 
-        fn = functools.partial(
-            sampling.decode_scan,
-            self.cfg,
-            n_steps=n_steps,
-            temperature=temperature,
-            topp=topp,
-            axis_name="tp",
-        )
+        cfg = self.cfg
+
+        def fn(params, first_token, cache, pos, seed):
+            return sampling.decode_scan(
+                cfg, params, first_token, cache, pos, seed, n_steps,
+                temperature, topp, topk, axis_name="tp",
+            )
+
         mapped = shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), self._cache_spec, P(), P()),
-            out_specs=(P(), self._cache_spec, P()),
+            out_specs=(P(), self._cache_spec),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
         self._decode_cache[key] = jitted
         return jitted
 
-    def decode_loop(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
+    def decode_loop(
+        self, params, first_token, cache, pos, n_steps, temperature, topp,
+        seed: int = 0, topk: int = 0,
+    ):
         """On-device autoregressive decode under TP: ONE dispatch for
         ``n_steps`` tokens, collectives riding the mesh every step. Sampling
-        runs replicated (same key → same token on every shard)."""
-        jitted = self._decode_jitted(int(n_steps), float(temperature), float(topp))
-        tokens, cache, _ = jitted(params, jnp.asarray(first_token), cache, jnp.asarray(pos), key)
+        runs replicated on counter coins (same (seed, position) → same token
+        on every shard)."""
+        from distributed_llama_tpu import prng
+
+        jitted = self._decode_jitted(
+            int(n_steps), float(temperature), float(topp), int(topk)
+        )
+        tokens, cache = jitted(
+            params, jnp.asarray(first_token), cache, jnp.asarray(pos),
+            jnp.uint32(prng.fold_seed(seed)),
+        )
         return tokens, cache
 
     def _chunk_jitted(self, n_steps: int):
@@ -481,31 +492,36 @@ class TensorParallelForward(TransferProbeMixin):
 
         cfg = self.cfg
 
-        def fn(params, first_token, cache, pos, temperature, topp, key):
+        def fn(params, first_token, cache, pos, temperature, topp, topk, seed):
             return sampling.decode_scan(
-                cfg, params, first_token, cache, pos, key, n_steps,
-                temperature, topp, axis_name="tp",
+                cfg, params, first_token, cache, pos, seed, n_steps,
+                temperature, topp, topk, axis_name="tp",
             )
 
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), self._cache_spec, P(), P(), P(), P()),
-            out_specs=(P(), self._cache_spec, P()),
+            in_specs=(self._specs, P(), self._cache_spec, P(), P(), P(), P(), P()),
+            out_specs=(P(), self._cache_spec),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
         self._chunk_cache[n_steps] = jitted
         return jitted
 
-    def decode_chunk(self, params, first_token, cache, pos, n_steps, temperature, topp, key):
-        """Chunked streaming decode under TP: temperature/topp are traced
-        (one compiled program per chunk size, no per-request recompiles) and
-        the advanced PRNG key is returned for the next chunk."""
+    def decode_chunk(
+        self, params, first_token, cache, pos, n_steps, temperature, topp,
+        topk, seed32,
+    ):
+        """Chunked streaming decode under TP: temperature/topp/topk are
+        traced (one compiled program per chunk size, no per-request
+        recompiles); coins re-key per position from the folded request
+        seed, so no sampler state returns."""
         jitted = self._chunk_jitted(int(n_steps))
         return jitted(
             params, jnp.asarray(first_token), cache, jnp.asarray(pos),
-            jnp.float32(temperature), jnp.float32(topp), key,
+            jnp.float32(temperature), jnp.float32(topp), jnp.int32(topk),
+            jnp.asarray(seed32, jnp.uint32),
         )
 
     def transfer_probe(self, n_tokens: int = 32):
@@ -632,22 +648,26 @@ class TensorParallelForward(TransferProbeMixin):
         cfg = self.cfg
         batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
 
-        def fn(params, first_tokens, cache, pos, active, temperature, topp, keys):
+        def fn(params, first_tokens, cache, pos, active, temperature, topp,
+               topk, seeds):
             from distributed_llama_tpu.engine import integrity
 
-            tokens, cache, keys, h, okf = sampling.batched_decode_scan(
-                cfg, params, first_tokens, cache, pos, active, keys, n_steps,
-                temperature, topp, axis_name="tp",
+            tokens, cache, h, okf = sampling.batched_decode_scan(
+                cfg, params, first_tokens, cache, pos, active, seeds, n_steps,
+                temperature, topp, topk, axis_name="tp",
             )
             # the fingerprint folds the all-gathered full-vocab logits, so
-            # every shard packs the same replicated bundle (integrity.py)
-            return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
+            # every shard packs the same replicated bundle (integrity.py);
+            # the sampler's candidate top-k composes over the sharded vocab
+            # BEFORE that gather (sampling.sharded_topk_indices)
+            return integrity.pack_chunk_outputs(tokens, h, okf), cache
 
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), batch_cache_spec, P(), P(), P(), P(), P()),
-            out_specs=(P(), batch_cache_spec, P()),
+            in_specs=(self._specs, P(), batch_cache_spec, P(), P(), P(), P(),
+                      P(), P()),
+            out_specs=(P(), batch_cache_spec),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -656,17 +676,17 @@ class TensorParallelForward(TransferProbeMixin):
 
     def batched_decode_chunk(
         self, params, first_tokens, cache, pos, active, n_steps, temperature,
-        topp, keys,
+        topp, topk, seeds,
     ):
         """One chunk of the batched multi-stream decode under TP: B
-        sequences step together with per-row positions/keys/sampler
+        sequences step together with per-row positions/seeds/sampler
         settings, collectives riding the mesh each step. One compiled
-        program per (bucket, chunk) shape."""
+        program per (bucket, chunk) shape; no sampler state returns."""
         jitted = self._batched_chunk_jitted(int(n_steps))
         return jitted(
             params, jnp.asarray(first_tokens), cache, jnp.asarray(pos),
             jnp.asarray(active), jnp.asarray(temperature), jnp.asarray(topp),
-            jnp.asarray(keys),
+            jnp.asarray(topk), jnp.asarray(seeds),
         )
 
     def _slab_forward_jitted(self):
@@ -800,22 +820,22 @@ class TensorParallelForward(TransferProbeMixin):
         batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
 
         def fn(params, first_tokens, cache, pool, pos, active, temperature,
-               topp, keys, tables, matched):
+               topp, topk, seeds, tables, matched):
             from distributed_llama_tpu.engine import integrity
 
-            tokens, cache, keys, h, okf = sampling.batched_decode_scan(
-                cfg, params, first_tokens, cache, pos, active, keys, n_steps,
-                temperature, topp, axis_name="tp",
+            tokens, cache, h, okf = sampling.batched_decode_scan(
+                cfg, params, first_tokens, cache, pos, active, seeds, n_steps,
+                temperature, topp, topk, axis_name="tp",
                 paged=(pool, tables, matched),
             )
-            return integrity.pack_chunk_outputs(tokens, h, okf), cache, keys
+            return integrity.pack_chunk_outputs(tokens, h, okf), cache
 
         mapped = shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), batch_cache_spec, self._pool_spec(),
-                      P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(P(), batch_cache_spec, P()),
+                      P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), batch_cache_spec),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -824,7 +844,7 @@ class TensorParallelForward(TransferProbeMixin):
 
     def batched_decode_chunk_paged(
         self, params, first_tokens, cache, pool, pos, active, n_steps,
-        temperature, topp, keys, tables, matched,
+        temperature, topp, topk, seeds, tables, matched,
     ):
         """One batched decode chunk with zero-copy prefix aliasing under
         TP: each shard's attention reads its pool half through the
@@ -835,7 +855,8 @@ class TensorParallelForward(TransferProbeMixin):
         return jitted(
             params, jnp.asarray(first_tokens), cache, pool, jnp.asarray(pos),
             jnp.asarray(active), jnp.asarray(temperature), jnp.asarray(topp),
-            jnp.asarray(keys), jnp.asarray(tables), jnp.asarray(matched),
+            jnp.asarray(topk), jnp.asarray(seeds), jnp.asarray(tables),
+            jnp.asarray(matched),
         )
 
     def _slab_forward_paged_jitted(self):

@@ -1,0 +1,137 @@
+"""Counter-mode PRNG for device-resident sampling (ISSUE 13).
+
+The decode scan samples ON DEVICE; the coin for the token drawn after
+consuming stream position ``p`` is a pure function of
+``(request seed, p, draw channel)`` — no generator state exists anywhere.
+That statelessness is the whole contract:
+
+* **Replay** — PR 9's failover replay and PR 8's preemption requeue re-run
+  a request from its prompt on another replica; positions are defined by
+  token content (prompt length + decode index), so the replayed stream
+  draws the exact coins of the original without any sampler state crossing
+  replicas. The jax.random split-chain this replaces carried an advanced
+  key per row per chunk — device-resident state the scheduler had to
+  thread through every dispatch and that could never migrate.
+* **Chunk independence** — a stream's draws depend only on positions,
+  never on how the decode was chunked into dispatches (the old key-thread
+  gave the same guarantee by carrying state; this gives it by having
+  none).
+* **Host parity** — the generator is pure uint32 arithmetic (xorshift/
+  multiply avalanche rounds, counter mode), implemented twice: in jnp for
+  the fused device sampler and in plain Python ints for the host
+  ``Sampler``'s counter mode. Integer ops are bit-identical by
+  construction, so a host replay of a device stream consumes the same
+  coins — the xorshift-parity verification mode the reference's seeded
+  runs had (src/utils.cpp:79-90), now spanning the host/device boundary.
+
+The mixer is the 32-bit xorshift-multiply avalanche (two
+shift-xor/multiply rounds — "lowbias32"-class): full avalanche on every
+input bit, 5 integer ops per round, trivially vectorizable. Not
+cryptographic, and not meant to be: sampling needs decorrelated uniforms,
+replay needs determinism.
+
+Draw channels keep the independent draws a single position can need from
+colliding: the plain categorical coin, the speculative accept coin, and
+the speculative redraw coin (Leviathan rejection re-draws at the same
+position its accept coin was spent on).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M32 = 0xFFFFFFFF
+_GOLD = 0x9E3779B9  # 2**32 / phi — the standard odd increment
+_MIX1 = 0x7FEB352D
+_MIX2 = 0x846CA68B
+_SALT = 0x85EBCA6B
+
+# draw channels (the third counter word): one position can legitimately
+# consume several independent uniforms
+DRAW_SAMPLE = 0  # the categorical coin of the fused sampler
+DRAW_SPEC_ACCEPT = 1  # speculative accept/reject coin at a draft position
+DRAW_SPEC_REDRAW = 2  # speculative residual/bonus redraw coin
+
+# 2**-24: coins are the top 24 bits of the mixed word — exactly
+# representable in f32, so host and device land on the identical float
+_INV24 = 1.0 / 16777216.0
+
+
+# ----------------------------------------------------------------------
+# Host side: plain Python ints (exact, no numpy overflow semantics)
+# ----------------------------------------------------------------------
+
+
+def mix32(x: int) -> int:
+    """One 32-bit xorshift-multiply avalanche (shift-xor, multiply, twice
+    over): every output bit depends on every input bit."""
+    x &= _M32
+    x ^= x >> 16
+    x = (x * _MIX1) & _M32
+    x ^= x >> 15
+    x = (x * _MIX2) & _M32
+    x ^= x >> 16
+    return x
+
+
+def fold_seed(seed: int) -> int:
+    """Fold an arbitrary-width request seed into the uint32 word the
+    counter is keyed on (seeds below 2**32 stay distinct; the high word is
+    avalanched in, not dropped). Host-side only — the device receives the
+    folded word, never the raw seed."""
+    s = int(seed) & 0xFFFFFFFFFFFFFFFF
+    return mix32((s & _M32) ^ mix32(((s >> 32) & _M32) ^ _GOLD))
+
+
+def coin_u32(seed32: int, pos: int, draw: int = DRAW_SAMPLE) -> int:
+    """The counter word for ``(seed32, pos, draw)`` — double-avalanched so
+    adjacent positions/draws decorrelate."""
+    return mix32(
+        (seed32 & _M32)
+        ^ mix32(((int(pos) * _GOLD) & _M32) ^ ((int(draw) * _SALT) & _M32))
+    )
+
+
+def coin_f32(seed32: int, pos: int, draw: int = DRAW_SAMPLE) -> np.float32:
+    """Uniform f32 in [0, 1): the top 24 mixed bits scaled by 2**-24 —
+    every value exact in f32, bit-identical to :func:`device_coin`."""
+    return np.float32((coin_u32(seed32, pos, draw) >> 8) * _INV24)
+
+
+# ----------------------------------------------------------------------
+# Device side: the same arithmetic on jnp.uint32 (wrapping by dtype)
+# ----------------------------------------------------------------------
+
+
+def device_mix32(x):
+    """:func:`mix32` on jnp uint32 arrays (elementwise)."""
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(_MIX1)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(_MIX2)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def device_coin_u32(seeds, pos, draw: int = DRAW_SAMPLE):
+    """:func:`coin_u32` on device: ``seeds`` uint32 [...], ``pos`` int32
+    [...] (broadcast together), ``draw`` a static int channel."""
+    import jax.numpy as jnp
+
+    seeds = jnp.asarray(seeds).astype(jnp.uint32)
+    p = jnp.asarray(pos).astype(jnp.uint32) * jnp.uint32(_GOLD)
+    d = jnp.uint32((draw * _SALT) & _M32)
+    return device_mix32(seeds ^ device_mix32(p ^ d))
+
+
+def device_coin(seeds, pos, draw: int = DRAW_SAMPLE):
+    """Uniform f32 coins in [0, 1) on device — bit-identical to
+    :func:`coin_f32` for the same counter (the top-24-bit construction is
+    exact in f32 on both sides)."""
+    import jax.numpy as jnp
+
+    u = device_coin_u32(seeds, pos, draw) >> jnp.uint32(8)
+    return u.astype(jnp.float32) * jnp.float32(_INV24)

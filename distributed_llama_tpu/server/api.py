@@ -445,7 +445,9 @@ class ApiState:
                 base if idx == 0 and i == 0 and engine is self.engine
                 else Sampler(
                     vocab_size=base.vocab_size, temperature=base.temperature,
-                    topp=base.topp, seed=base.seed + idx * self._lanes + i,
+                    topp=base.topp, topk=base.topk,
+                    seed=base.seed + idx * self._lanes + i,
+                    counter=base.counter,
                 ),
             )
             for i, s in enumerate(streams)
@@ -518,7 +520,7 @@ class ApiState:
             n = max(1, min(self.canary_tokens, budget))
             if budget < 1:
                 return None  # probe prompt does not fit this config
-            first_dev, key = stream.prefill_device(toks, 0.0, self.args.topp, 0)
+            first_dev = stream.prefill_device(toks, 0.0, self.args.topp, 0)
             out: list[int] = []
 
             def on_token(prev: int, t: int) -> bool:
@@ -526,7 +528,7 @@ class ApiState:
                 return len(out) < n
 
             stream.stream_decode(
-                first_dev, on_token, 0.0, self.args.topp, seed=0, key=key,
+                first_dev, on_token, 0.0, self.args.topp, seed=0,
                 first_prev=toks[-1], limit=len(toks) + n,
             )
             if not out:
@@ -845,20 +847,24 @@ class ApiState:
         # released at the first-token fetch that would never happen
         max_new = max_pos - prompt_end
 
+        topp = params.get("topp", self.args.topp)
+        topk = params.get("topk", getattr(self.args, "topk", 0) or 0)
         slot.sampler.set_temperature(params["temperature"])
-        if params["seed"] is not None:
-            slot.sampler.set_seed(params["seed"])
+        slot.sampler.topp = topp
+        slot.sampler.set_topk(topk)
+        # complete() pins params["seed"] (wall-clock for seedless requests)
+        # BEFORE the first attempt, so requeue replays re-draw the same
+        # coins — one defaulting site, there, not here
+        seed = params["seed"]
+        slot.sampler.set_seed(seed)
 
         device_decode = getattr(self.args, "decode", "device") == "device" and max_new > 0
-        seed = params["seed"]
-        if seed is None:
-            seed = int(time.time_ns() % (1 << 31))
         if device_decode:
             # prefill→decode fusion: the first generated token is sampled on
             # device and never visits the host before chunk 1 is dispatched —
             # one tunnel round trip per request instead of two (docs/PERF.md)
-            first_dev, chunk_key = engine.prefill_device(
-                prompt_tokens, params["temperature"], self.args.topp, seed
+            first_dev = engine.prefill_device(
+                prompt_tokens, params["temperature"], topp, seed, topk
             )
         else:
             logits = engine.prefill(prompt_tokens)
@@ -920,19 +926,25 @@ class ApiState:
                     return emitted < max_new
 
                 engine.stream_decode(
-                    first_dev, on_token, params["temperature"], self.args.topp,
+                    first_dev, on_token, params["temperature"], topp,
                     seed=seed, chunk=getattr(self.args, "decode_chunk", 32),
-                    limit=max_pos, key=chunk_key, first_prev=prompt_tokens[-1],
+                    limit=max_pos, first_prev=prompt_tokens[-1],
                     # self-speculative decode (--spec-draft k): prompt-lookup
                     # drafts over this request's prompt + output, verified
                     # k at a time in one weight read; 0 = plain chunked path
                     spec_draft=getattr(self.args, "spec_draft", 0),
                     spec_ngram=getattr(self.args, "spec_ngram", 3),
                     prompt_tokens=prompt_tokens,
+                    topk=topk,
                 )
         else:
+            # --decode host: the per-token fallback regime — every token
+            # pays a logits fetch + host sort, counted by
+            # dllama_host_sampler_fallback_total; the counter-mode sampler
+            # keys each coin on the consumed position, so the stream is
+            # token-identical to the device path per seed
             if max_new > 0:
-                token = slot.sampler.sample(logits)  # first token: host sampler
+                token = slot.sampler.sample(logits, pos=engine.pos - 1)
                 res = feed(prompt_tokens[-1], token)
             if res == EosDetectorResult.EOS:
                 finish_reason = "stop"
@@ -940,7 +952,7 @@ class ApiState:
                 while emitted < max_new and engine.pos < seq_len:
                     prev = token
                     logits = engine.decode_step(prev)
-                    token = slot.sampler.sample(logits)
+                    token = slot.sampler.sample(logits, pos=engine.pos - 1)
                     res = feed(prev, token)
                     if res == EosDetectorResult.EOS:
                         finish_reason = "stop"
@@ -1040,6 +1052,10 @@ class ApiState:
             raise BadRequest("'stop' must be a string, an array of strings, or null")
         try:
             temperature = float(body.get("temperature", self.args.temperature))
+            # per-request sampler filters (OpenAI names): defaults are the
+            # server's --topp/--topk; both ride the fused device sampler
+            topp = float(body.get("top_p", self.args.topp))
+            topk = int(body.get("top_k", getattr(self.args, "topk", 0) or 0))
             max_tokens = int(body.get("max_tokens", -1))
             seed = body.get("seed")
             if seed is not None:
@@ -1076,6 +1092,10 @@ class ApiState:
         cache = body.get("cache", "on")
         if cache not in ("on", "off"):
             raise BadRequest("'cache' must be \"on\" or \"off\"")
+        if not (0.0 <= topp <= 1.0) or not math.isfinite(topp):
+            raise BadRequest("'top_p' must be a number in [0, 1]")
+        if topk < 0:
+            raise BadRequest("'top_k' must be a non-negative integer (0 = off)")
         return {
             "cache": cache,
             "messages": [
@@ -1083,6 +1103,8 @@ class ApiState:
             ],
             "stream": bool(body.get("stream", False)),
             "temperature": temperature,
+            "topp": topp,
+            "topk": topk,
             "seed": seed,
             "max_tokens": max_tokens,
             "stop": [s for s in stop if s],

@@ -233,6 +233,16 @@ class EngineInstruments:
             "(a chunked dispatch contributes one observation at its per-token "
             "average; dllama_tokens_generated_total counts the tokens)",
         )
+        # device-resident sampling (ISSUE 13): the happy-path witness —
+        # tokens whose temperature/top-k/top-p draw ran INSIDE the decode
+        # program (counter-PRNG coins, no logits fetch, no host sort);
+        # dllama_host_sampler_fallback_total counts the complement
+        self.device_sampled_tokens = counter(
+            "dllama_device_sampled_tokens_total",
+            "Tokens sampled on device by the fused decode-scan sampler "
+            "(greedy argmax rows included); only int32 token ids crossed "
+            "the host for these",
+        )
         self.kv_occupancy = gauge(
             "dllama_kv_cache_occupancy",
             "KV-cache occupancy of the most recently active stream "
@@ -566,4 +576,16 @@ class SamplerInstruments:
             "Host-sampled tokens by method (greedy / topp); device-sampled "
             "tokens are counted by dllama_tokens_generated_total instead",
             labelnames=("method",),
+        )
+        # device-resident sampling (ISSUE 13): with the fused sampler every
+        # decode token is drawn inside the device program — any host
+        # Sampler.sample() call is by definition the fallback path
+        # (--decode host, or a caller doing its own logits fetch); the
+        # happy-path CI smoke gates --expect-zero on this
+        self.fallback = counter(
+            "dllama_host_sampler_fallback_total",
+            "Tokens sampled by the HOST Sampler (the --decode host "
+            "fallback): every one paid a full-vocab logits fetch and a "
+            "host sort the fused device sampler exists to delete; 0 on "
+            "the device-resident happy path",
         )

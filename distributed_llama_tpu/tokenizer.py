@@ -218,16 +218,41 @@ def _softmax(x: np.ndarray) -> np.ndarray:
 
 @dataclasses.dataclass
 class Sampler:
-    """Greedy / temperature / top-p sampling on host logits
-    (reference: src/tokenizer.cpp:371-415)."""
+    """Greedy / temperature / top-k / top-p sampling on host logits
+    (reference: src/tokenizer.cpp:371-415).
+
+    Two RNG modes:
+
+    * legacy (``counter=False``): the reference's sequential xorshift64*
+      state — one coin per call in call order, bit-identical to the
+      reference's draw sequence (the interop contract).
+    * counter (``counter=True``): the stateless counter PRNG of
+      :mod:`distributed_llama_tpu.prng`, coin keyed ``(seed, pos)`` — the
+      host half of the device-sampling parity contract (ISSUE 13). Fed
+      the same f32 logits, this mode replays a device-sampled stream
+      token for token: identical candidate order (descending scaled
+      logit, ties by id), identical f32 filter/CDF arithmetic, identical
+      coins. ``sample`` then REQUIRES ``pos`` (the absolute position of
+      the consumed token). Exact on the filtered (top-k/top-p) paths;
+      the unfiltered multinomial path walks a full-vocab cumsum whose
+      device counterpart may associate differently by ulps.
+
+    Every ``sample`` call counts toward
+    ``dllama_host_sampler_fallback_total``: with the fused device sampler
+    in place, host sampling IS the fallback path."""
 
     vocab_size: int
     temperature: float = 0.8
     topp: float = 0.9
     seed: int = 0
+    topk: int = 0
+    counter: bool = False
 
     def __post_init__(self):
         self._rng = XorshiftRng(self.seed)
+        from distributed_llama_tpu import prng as _prng
+
+        self._seed32 = _prng.fold_seed(self.seed)
         # sampler-distribution counters (ISSUE 1): bound once per sampler —
         # shared no-op singletons when telemetry is disabled, so the
         # per-token host-sampling path never touches the registry
@@ -236,12 +261,31 @@ class Sampler:
         self._tel = telemetry.SamplerInstruments()
 
     def set_seed(self, seed: int) -> None:
+        from distributed_llama_tpu import prng as _prng
+
+        self.seed = seed
         self._rng = XorshiftRng(seed)
+        self._seed32 = _prng.fold_seed(seed)
 
     def set_temperature(self, temperature: float) -> None:
         self.temperature = temperature
 
-    def sample(self, logits: np.ndarray) -> int:
+    def set_topk(self, topk: int) -> None:
+        self.topk = int(topk)
+
+    def _coin(self, pos: int | None) -> float:
+        if not self.counter:
+            return self._rng.next_f32()
+        if pos is None:
+            raise ValueError(
+                "counter-mode Sampler.sample needs pos (the absolute "
+                "position of the consumed token) to key its coin"
+            )
+        from distributed_llama_tpu import prng as _prng
+
+        return float(_prng.coin_f32(self._seed32, pos, _prng.DRAW_SAMPLE))
+
+    def sample(self, logits: np.ndarray, pos: int | None = None) -> int:
         logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
         if not np.isfinite(logits).all():
             # validate BEFORE sampling (ISSUE 10 satellite): NaN/Inf
@@ -257,16 +301,56 @@ class Sampler:
                 f"({int((~np.isfinite(logits)).sum())} of {logits.size} "
                 "entries); refusing to sample a plausible-but-wrong token"
             )
+        self._tel.fallback.inc()
         if self.temperature == 0.0:
             self._tel.sampled.labels(method="greedy").inc()
             return int(np.argmax(logits))
+        if self.counter or 0 < self.topk < logits.size:
+            # top-k predates nothing: the legacy draw arithmetic never had
+            # it, so an ACTIVE top-k always routes through the fused-pick
+            # mirror (fed the legacy sequential coin when counter is off)
+            # rather than being silently ignored
+            return self._sample_counter(logits, self._coin(pos))
         probs = _softmax(logits / self.temperature)
-        coin = self._rng.next_f32()
+        coin = self._coin(pos)
         if self.topp <= 0 or self.topp >= 1:
             self._tel.sampled.labels(method="multinomial").inc()
             return self._sample_mult(probs, coin)
         self._tel.sampled.labels(method="topp").inc()
         return self._sample_topp(probs, coin)
+
+    def _sample_counter(self, logits: np.ndarray, coin: float) -> int:
+        """The device fused sampler's arithmetic, op for op in f32
+        (models/sampling.py ``fused_pick``): candidates ordered by
+        descending temperature-scaled logit (ties by lower id), the kept
+        prefix is min(top-k, nucleus), the draw is inverse-CDF over the
+        kept prefix's f32 cumulative mass — same values, same coin, same
+        pick as the device program this mode verifies."""
+        n = logits.size
+        scaled = (logits / np.float32(self.temperature)).astype(np.float32)
+        m = scaled.max()
+        e = np.exp(scaled - m, dtype=np.float32)
+        probs = (e / e.sum(dtype=np.float32)).astype(np.float32)
+        coin = np.float32(coin)
+        topp_act = 0.0 < self.topp < 1.0
+        topk_act = 0 < self.topk < n
+        if not (topp_act or topk_act):
+            # multinomial: vocab-order inverse CDF over the full mass
+            self._tel.sampled.labels(method="multinomial").inc()
+            cdf = np.cumsum(probs, dtype=np.float32)
+            r = coin * cdf[-1]
+            return min(int(np.sum(cdf <= r)), n - 1)
+        self._tel.sampled.labels(method="topp" if topp_act else "topk").inc()
+        order = np.argsort(-scaled, kind="stable")
+        vals = probs[order]
+        cum = np.cumsum(vals, dtype=np.float32)
+        n_nuc = int(np.sum(cum - vals < np.float32(self.topp))) if topp_act else n
+        n_k = self.topk if topk_act else n
+        n_keep = max(1, min(n_nuc, n_k, n))
+        total = cum[n_keep - 1]
+        r = coin * total
+        idx = min(int(np.sum(cum[:n_keep] <= r)), n_keep - 1)
+        return int(order[idx])
 
     @staticmethod
     def _sample_mult(probs: np.ndarray, coin: float) -> int:

@@ -32,7 +32,7 @@ def single_stream_tokens(engine, prompt, temp, topp, seed, n):
     """The reference stream: one request through the single-stream fused
     serving flow (prefill_device → stream_decode) on its own EngineStream."""
     s = engine.new_stream()
-    first, key = s.prefill_device(prompt, temp, topp, seed)
+    first = s.prefill_device(prompt, temp, topp, seed)
     got = []
 
     def on_token(prev, tok):
@@ -40,13 +40,13 @@ def single_stream_tokens(engine, prompt, temp, topp, seed, n):
         return len(got) < n
 
     s.stream_decode(first, on_token, temp, topp, seed=seed, chunk=4,
-                    limit=s.pos + n, key=key, first_prev=prompt[-1])
+                    limit=s.pos + n, first_prev=prompt[-1])
     return got
 
 
 def batch_stream_tokens(stream, prompt, temp, topp, seed, n):
     """The same request through a BatchScheduler row."""
-    first, key = stream.prefill_device(prompt, temp, topp, seed)
+    first = stream.prefill_device(prompt, temp, topp, seed)
     got = []
 
     def on_token(prev, tok):
@@ -54,7 +54,7 @@ def batch_stream_tokens(stream, prompt, temp, topp, seed, n):
         return len(got) < n
 
     stream.stream_decode(first, on_token, temp, topp, seed=seed,
-                         limit=stream.pos + n, key=key, first_prev=prompt[-1])
+                         limit=stream.pos + n, first_prev=prompt[-1])
     return got
 
 
@@ -148,7 +148,7 @@ class TestBatchedParity:
 
         def run_a():
             try:
-                first, key = sa.prefill_device(PROMPTS[0], 0.0, 0.9, 11)
+                first = sa.prefill_device(PROMPTS[0], 0.0, 0.9, 11)
 
                 def on_token(prev, tok):
                     out_a.append(tok)
@@ -157,7 +157,7 @@ class TestBatchedParity:
                     return len(out_a) < 12
 
                 sa.stream_decode(first, on_token, 0.0, 0.9, seed=11,
-                                 limit=sa.pos + 12, key=key,
+                                 limit=sa.pos + 12,
                                  first_prev=PROMPTS[0][-1])
             except Exception as e:  # pragma: no cover
                 errors.append(e)

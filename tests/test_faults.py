@@ -65,7 +65,7 @@ def run_streams(sched, streams, n=10, sampling=None):
         temp, topp, seed = (sampling or {}).get(i, (0.0, 0.9, 11 + i))
         try:
             prompt = PROMPTS[i % len(PROMPTS)]
-            first, key = s.prefill_device(prompt, temp, topp, seed)
+            first = s.prefill_device(prompt, temp, topp, seed)
             got = []
 
             def on_token(prev, tok):
@@ -73,7 +73,7 @@ def run_streams(sched, streams, n=10, sampling=None):
                 return len(got) < n
 
             s.stream_decode(first, on_token, temp, topp, seed=seed,
-                            limit=s.pos + n, key=key, first_prev=prompt[-1])
+                            limit=s.pos + n, first_prev=prompt[-1])
             outs[i] = got
         except Exception as e:
             errs[i] = e

@@ -191,10 +191,10 @@ class TestNonFiniteLogits:
         engine.params = treedef.unflatten(poisoned)
         sched = BatchScheduler(engine, n_rows=2, chunk=4)
         s = sched.new_stream()
-        first, key = s.prefill_device([1, 5, 9], 0.8, 0.9, 7)  # SAMPLED path
+        first = s.prefill_device([1, 5, 9], 0.8, 0.9, 7)  # SAMPLED path
         with pytest.raises(faults.NonFiniteLogits):
             s.stream_decode(
-                first, lambda p, t: True, 0.8, 0.9, seed=7, key=key,
+                first, lambda p, t: True, 0.8, 0.9, seed=7,
                 first_prev=9, limit=s.pos + 12,
             )
         sched.close()
@@ -207,7 +207,7 @@ class TestNonFiniteLogits:
 
 def _greedy_batch_tokens(sched, prompt, n):
     s = sched.new_stream()
-    first, key = s.prefill_device(prompt, 0.0, 0.9, 0)
+    first = s.prefill_device(prompt, 0.0, 0.9, 0)
     got = []
 
     def on_token(prev, tok):
@@ -215,7 +215,7 @@ def _greedy_batch_tokens(sched, prompt, n):
         return len(got) < n
 
     s.stream_decode(
-        first, on_token, 0.0, 0.9, seed=0, key=key, first_prev=prompt[-1],
+        first, on_token, 0.0, 0.9, seed=0, first_prev=prompt[-1],
         limit=s.pos + n,
     )
     # fold exactly the chunks behind the consumed tokens: the pipelined
@@ -652,11 +652,12 @@ def test_fingerprint_decode_overhead_under_1_percent():
     params = random_params_on_device(cfg, dtype=jnp.float32, seed=0, layered=True)
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def run(fingerprint, cache, keys):
+    def run(fingerprint, cache, seeds):
         return batched_decode_scan(
             cfg, params, jnp.ones(B, jnp.int32), cache,
-            jnp.zeros(B, jnp.int32), jnp.ones(B, bool), keys, CHUNK,
+            jnp.zeros(B, jnp.int32), jnp.ones(B, bool), seeds, CHUNK,
             jnp.zeros(B, jnp.float32), jnp.full(B, 0.9, jnp.float32),
+            jnp.zeros(B, jnp.int32),
             fingerprint=fingerprint,
         )
 
@@ -664,9 +665,9 @@ def test_fingerprint_decode_overhead_under_1_percent():
         samples = []
         for rep in range(4):
             cache = llama.init_batch_cache(cfg, B, dtype=jnp.float32)
-            keys = jnp.stack([jax.random.PRNGKey(i) for i in range(B)])
+            seeds = jnp.arange(B, dtype=jnp.uint32)
             t0 = time.perf_counter()
-            out = run(fingerprint, cache, keys)
+            out = run(fingerprint, cache, seeds)
             jax.block_until_ready(out)
             dt = time.perf_counter() - t0
             if rep > 0:  # rep 0 is the compile

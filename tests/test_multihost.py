@@ -63,7 +63,7 @@ WORKER_SCRIPT = textwrap.dedent(
     logits, cache = tp_engine.forward(params, np.asarray([1, 5, 9], np.int32), cache, np.int32(0))
     first = int(np.argmax(np.asarray(logits[-1])))
     tokens, cache = tp_engine.decode_loop(
-        params, np.int32(first), cache, np.int32(3), 6, 0.0, 0.9, jax.random.PRNGKey(0)
+        params, np.int32(first), cache, np.int32(3), 6, 0.0, 0.9, seed=0
     )
     print("RESULT " + json.dumps({{
         "tokens": [first] + np.asarray(tokens).tolist(),

@@ -40,7 +40,7 @@ def build_engine(tmp_path, name="model.m", seed=0, seq_len=96, cache_dtype=None,
 def decode_tokens(stream, prompt, temp, topp, seed, n, spec_draft=None):
     """One request through the fused serving flow on a scheduler row."""
     stream.reset()
-    first, key = stream.prefill_device(prompt, temp, topp, seed)
+    first = stream.prefill_device(prompt, temp, topp, seed)
     got = []
 
     def on_token(prev, tok):
@@ -51,7 +51,7 @@ def decode_tokens(stream, prompt, temp, topp, seed, n, spec_draft=None):
     if spec_draft is not None:
         kw = dict(spec_draft=spec_draft, prompt_tokens=prompt)
     stream.stream_decode(first, on_token, temp, topp, seed=seed,
-                         limit=stream.pos + n, key=key, first_prev=prompt[-1],
+                         limit=stream.pos + n, first_prev=prompt[-1],
                          **kw)
     return got
 
@@ -444,12 +444,12 @@ class TestPinLifetime:
         s = sched.new_stream()
         decode_tokens(s, PROMPT, 0.0, 0.9, 7, 2)  # publish PROMPT's pages
         s.reset()
-        first, key = s.prefill_device(PROMPT, 0.0, 0.9, 7)  # hit: matched 8
+        first = s.prefill_device(PROMPT, 0.0, 0.9, 7)  # hit: matched 8
         s.fetch_first_token(first)
         assert s.matched_len == 2 * PAGE
         s.rollback(len(shared))  # 6 < 8: truncate the alias mid-page
         assert s.matched_len == 6
-        first, key = s.prefill_device(divergent, 0.0, 0.9, 7)
+        first = s.prefill_device(divergent, 0.0, 0.9, 7)
         got = []
 
         def on_token(prev, tok):
@@ -457,7 +457,7 @@ class TestPinLifetime:
             return len(got) < 8
 
         s.stream_decode(first, on_token, 0.0, 0.9, seed=7,
-                        limit=s.pos + 8, key=key, first_prev=divergent[-1])
+                        limit=s.pos + 8, first_prev=divergent[-1])
         assert got == want
         sched.check_prefix()
 

@@ -35,7 +35,7 @@ def decode_tokens(stream, prompt, temp, topp, seed, n, prefix_enabled=None):
     stream.reset()
     if prefix_enabled is not None:
         stream.prefix_cache_enabled = prefix_enabled
-    first, key = stream.prefill_device(prompt, temp, topp, seed)
+    first = stream.prefill_device(prompt, temp, topp, seed)
     got = []
 
     def on_token(prev, tok):
@@ -43,7 +43,7 @@ def decode_tokens(stream, prompt, temp, topp, seed, n, prefix_enabled=None):
         return len(got) < n
 
     stream.stream_decode(first, on_token, temp, topp, seed=seed,
-                         limit=stream.pos + n, key=key, first_prev=prompt[-1])
+                         limit=stream.pos + n, first_prev=prompt[-1])
     return got
 
 
@@ -522,7 +522,7 @@ class TestChunkedPrefill:
 
         def decoder():
             try:
-                first, key = s0.prefill_device([1, 5, 9], 0.0, 0.9, 3)
+                first = s0.prefill_device([1, 5, 9], 0.0, 0.9, 3)
 
                 def on_token(prev, tok):
                     if not prefill_done.is_set():
@@ -530,7 +530,7 @@ class TestChunkedPrefill:
                     return not prefill_done.is_set()
 
                 s0.stream_decode(first, on_token, 0.0, 0.9, seed=3,
-                                 limit=s0.pos + 40, key=key, first_prev=9)
+                                 limit=s0.pos + 40, first_prev=9)
             except Exception as e:  # pragma: no cover
                 errors.append(e)
 

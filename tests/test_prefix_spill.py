@@ -64,7 +64,7 @@ def build_sched(engine, kv_pages=6, spill_mb=32, arena=None, **kw):
 
 def decode_tokens(stream, prompt, n=6, seed=3):
     stream.reset()
-    first, key = stream.prefill_device(prompt, 0.0, 0.9, seed)
+    first = stream.prefill_device(prompt, 0.0, 0.9, seed)
     got = []
 
     def on_token(prev, tok):
@@ -72,7 +72,7 @@ def decode_tokens(stream, prompt, n=6, seed=3):
         return len(got) < n
 
     stream.stream_decode(first, on_token, 0.0, 0.9, seed=seed,
-                         limit=stream.pos + n, key=key, first_prev=prompt[-1])
+                         limit=stream.pos + n, first_prev=prompt[-1])
     return got
 
 

@@ -1,12 +1,15 @@
-"""On-device sampling + fused decode loop tests."""
+"""On-device sampling + fused decode loop tests (counter-PRNG sampler)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributed_llama_tpu.engine import InferenceEngine
-from distributed_llama_tpu.models.sampling import sample_token
+from distributed_llama_tpu.models.sampling import (
+    TOPP_FAST_K,
+    fused_pick,
+    fused_sample_batched,
+    sample_token,
+)
 
 from tests.model_utils import random_tensors, tiny_spec, write_model_file
 
@@ -21,71 +24,173 @@ def build_engine(tmp_path, spec, seed=0):
 class TestSampleToken:
     def test_greedy(self):
         logits = jnp.asarray([0.1, 3.0, -1.0, 2.9])
-        tok = sample_token(logits, jax.random.PRNGKey(0), 0.0, 0.9)
+        tok = sample_token(logits, 0, 0, 0.0, 0.9)
         assert int(tok) == 1
 
     def test_topp_restricts_to_nucleus(self):
         logits = jnp.full((50,), -10.0).at[7].set(10.0)
         for s in range(10):
-            tok = sample_token(logits, jax.random.PRNGKey(s), 1.0, 0.5)
+            tok = sample_token(logits, s, 0, 1.0, 0.5)
             assert int(tok) == 7
+
+    def test_topk_restricts_to_topk(self):
+        logits = jnp.asarray([5.0, 4.0, -10.0, -10.0, -10.0])
+        seen = {
+            int(sample_token(logits, s, 0, 2.0, 0.0, topk=2))
+            for s in range(60)
+        }
+        assert seen <= {0, 1}
 
     def test_temperature_sampling_covers_support(self):
         logits = jnp.zeros(4)
-        seen = {
-            int(sample_token(logits, jax.random.PRNGKey(s), 1.0, 0.0)) for s in range(50)
-        }
+        seen = {int(sample_token(logits, s, 0, 1.0, 0.0)) for s in range(50)}
         assert seen == {0, 1, 2, 3}
 
+    def test_coin_varies_with_position_not_state(self):
+        """The counter PRNG keys the coin on (seed, pos): same inputs →
+        same token, different positions → an eventually-different draw, no
+        generator state anywhere."""
+        logits = jnp.zeros(8)
+        a = [int(sample_token(logits, 3, p, 1.0, 0.0)) for p in range(20)]
+        b = [int(sample_token(logits, 3, p, 1.0, 0.0)) for p in range(20)]
+        assert a == b  # stateless: replay is trivially identical
+        assert len(set(a)) > 1  # positions decorrelate the draws
 
-class TestToppThresholdBoundary:
-    """The nucleus-threshold fast path (top-k of TOPP_FAST_K) must agree
-    with the full-vocab sort exactly when the nucleus ends AT the fast-path
-    boundary — the largest nucleus the fast path may legally serve."""
 
-    def _full_sort_threshold(self, probs, topp):
-        s = np.sort(probs)[::-1]
-        cum = np.cumsum(s)
-        cutoff = int(np.sum(cum - s < topp))
-        return s[max(cutoff - 1, 0)]
+def _dyadic_probs():
+    """Dyadic probabilities (exact in f32, cumsums included): entries
+    0..TOPP_FAST_K-1 hold 1/256 each (cumulative exactly 0.5), the 256
+    tail entries 1/512 each — no rounding anywhere, so the nucleus
+    boundary is bit-exact, not a float knife-edge, and the sorted order
+    is the identity (ties never cross the boundary)."""
+    probs = np.full(TOPP_FAST_K + 256, 1.0 / 512.0, np.float32)
+    probs[:TOPP_FAST_K] = np.float32(0.5) / TOPP_FAST_K  # 1/256
+    return probs
 
-    def _boundary_probs(self):
-        """Dyadic probabilities (exact in f32, cumsums included): the top
-        TOPP_FAST_K entries hold 1/256 each (cumulative exactly 0.5), the
-        256 tail entries 1/512 each — no rounding anywhere, so the nucleus
-        boundary is bit-exact, not a float knife-edge."""
-        from distributed_llama_tpu.models.sampling import TOPP_FAST_K
 
-        probs = np.full(TOPP_FAST_K + 256, 1.0 / 512.0, np.float32)
-        probs[:TOPP_FAST_K] = np.float32(0.5) / TOPP_FAST_K  # 1/256
-        return probs
+# the largest f32 coin the counter PRNG can produce ((2**24 - 1) / 2**24):
+# drives the pick to the LAST kept candidate — the boundary witness
+_COIN_MAX = np.float32((2**24 - 1) / 2**24)
+
+
+def _pick(probs, coin, topp, topk):
+    """One fused_pick call on explicit probabilities (order = identity:
+    ``scaled`` is fed the probs themselves, which sorts identically)."""
+    p = jnp.asarray(probs)[None, :]
+    tok = fused_pick(
+        p, p, jnp.asarray([coin], jnp.float32),
+        jnp.asarray([topp], jnp.float32), jnp.asarray([topk], jnp.int32),
+    )
+    return int(tok[0])
+
+
+class TestFusedPickBoundary:
+    """The dyadic-exact nucleus/top-k boundary contract of the FUSED
+    device sampler (the PR 6 threshold tests, extended to the fused path
+    per ISSUE 13): when the kept prefix ends exactly at the
+    ``TOPP_FAST_K`` fast-path window, the fast path must serve it
+    bit-exactly, and one step past the window must route to the full
+    sort and keep serving exactly."""
 
     def test_nucleus_ends_exactly_at_fast_k(self):
-        from distributed_llama_tpu.models.sampling import (
-            TOPP_FAST_K,
-            _topp_threshold,
-        )
-
-        probs = self._boundary_probs()
+        probs = _dyadic_probs()
         # topp = 0.5 = the cumulative mass of exactly the top TOPP_FAST_K
         # entries: the largest nucleus the fast path may legally serve —
-        # cum_k[-1] >= topp holds with equality and the threshold must be
-        # the boundary element itself
-        got = float(_topp_threshold(jnp.asarray(probs), jnp.float32(0.5)))
-        want = self._full_sort_threshold(probs, np.float32(0.5))
-        assert got == float(want) == float(np.float32(0.5) / TOPP_FAST_K)
+        # every coin must land inside the top TOPP_FAST_K candidates, and
+        # the max coin must land on the BOUNDARY element itself
+        for coin in (0.0, 0.25, 0.75, float(_COIN_MAX)):
+            tok = _pick(probs, coin, 0.5, 0)
+            assert tok < TOPP_FAST_K, (coin, tok)
+        assert _pick(probs, float(_COIN_MAX), 0.5, 0) == TOPP_FAST_K - 1
 
     def test_nucleus_one_past_fast_k_takes_full_sort(self):
-        from distributed_llama_tpu.models.sampling import _topp_threshold
+        probs = _dyadic_probs()
+        # one half-tail-element of extra mass: cum[TOPP_FAST_K-1] = 0.5 <
+        # topp, so the lax.cond must route to the full sort — whose kept
+        # prefix is exactly TOPP_FAST_K + 1 wide, and the max coin must
+        # land on the first tail element (the one the window cannot see)
+        topp = float(np.float32(0.5 + 1.0 / 1024.0))
+        assert _pick(probs, float(_COIN_MAX), topp, 0) == TOPP_FAST_K
 
-        probs = self._boundary_probs()
-        # one half-tail-element of extra mass: cum_k[-1] = 0.5 < topp, so
-        # the lax.cond must route to the full sort — whose answer at the
-        # seam (the first tail element) must match the numpy reference
-        topp = np.float32(0.5 + 1.0 / 1024.0)
-        got = float(_topp_threshold(jnp.asarray(probs), jnp.float32(topp)))
-        want = self._full_sort_threshold(probs, topp)
-        assert got == float(want) == float(np.float32(1.0 / 512.0))
+    def test_topk_exactly_at_fast_k(self):
+        probs = _dyadic_probs()
+        # bare top-k at the window width: fast path, last kept = K-1
+        assert _pick(probs, float(_COIN_MAX), 0.0, TOPP_FAST_K) == TOPP_FAST_K - 1
+
+    def test_topk_one_past_fast_k_takes_full_sort(self):
+        probs = _dyadic_probs()
+        assert (
+            _pick(probs, float(_COIN_MAX), 0.0, TOPP_FAST_K + 1) == TOPP_FAST_K
+        )
+
+    def test_topk_composes_with_nucleus_at_boundary(self):
+        probs = _dyadic_probs()
+        # nucleus says TOPP_FAST_K, top-k says less: min wins exactly
+        assert _pick(probs, float(_COIN_MAX), 0.5, 7) == 6
+
+    def test_matches_numpy_reference(self):
+        """The device keep-count rule against an independent numpy
+        reference (the PR 6 full-sort oracle, restated for the fused
+        keep-prefix form) on the dyadic distribution."""
+        probs = _dyadic_probs()
+
+        def ref_keep(p, topp, topk):
+            s = np.sort(p)[::-1]
+            cum = np.cumsum(s)
+            n_nuc = int(np.sum(cum - s < topp)) if 0 < topp < 1 else p.size
+            n_k = topk if topk > 0 else p.size
+            return max(1, min(n_nuc, n_k))
+
+        for topp, topk in [(0.5, 0), (0.25, 0), (0.5, 64), (0.75, 0), (0.0, 130)]:
+            n_keep = ref_keep(probs, np.float32(topp), topk)
+            # the max coin lands on the last kept candidate = rank n_keep-1
+            assert _pick(probs, float(_COIN_MAX), topp, topk) == n_keep - 1
+
+
+class TestGreedyRowsInSampledBatch:
+    """ISSUE 13 satellite: a temperature=0 row co-batched with sampled
+    rows must take the exact argmax path — bit-identical to a pure-greedy
+    batch — including at the TOPP_FAST_K boundary (dyadic probs, nucleus
+    ending exactly at k), where the greedy row must not be routed through
+    the sampled pick by the shared program."""
+
+    def test_greedy_row_bit_identical_across_batch_mixes(self):
+        rng = np.random.RandomState(0)
+        V = TOPP_FAST_K + 256
+        logits = rng.randn(4, V).astype(np.float32) * 2.0
+        seeds = jnp.asarray([5, 6, 7, 8], jnp.uint32)
+        pos = jnp.asarray([3, 9, 2, 7], jnp.int32)
+        pure = fused_sample_batched(
+            jnp.asarray(logits), seeds, pos, jnp.zeros(4, jnp.float32),
+            jnp.full(4, 0.9, jnp.float32), jnp.zeros(4, jnp.int32),
+        )
+        mixed_t = jnp.asarray([0.0, 0.9, 0.0, 1.3], jnp.float32)
+        mixed_k = jnp.asarray([0, 5, 0, 0], jnp.int32)
+        mixed = fused_sample_batched(
+            jnp.asarray(logits), seeds, pos, mixed_t,
+            jnp.full(4, 0.9, jnp.float32), mixed_k,
+        )
+        want = np.argmax(logits, axis=-1)
+        assert np.asarray(pure).tolist() == want.tolist()
+        got = np.asarray(mixed)
+        assert got[0] == want[0] and got[2] == want[2]
+
+    def test_greedy_row_at_dyadic_boundary(self):
+        # row 0 greedy over the dyadic distribution (argmax = index 0, the
+        # first max element), row 1 sampled with the nucleus ending exactly
+        # at TOPP_FAST_K: the sampled row's full/fast routing must not
+        # perturb the greedy row's argmax
+        probs = _dyadic_probs()
+        logits = np.log(np.stack([probs, probs]))
+        out = fused_sample_batched(
+            jnp.asarray(logits), jnp.asarray([1, 2], jnp.uint32),
+            jnp.asarray([0, 0], jnp.int32),
+            jnp.asarray([0.0, 1.0], jnp.float32),
+            jnp.asarray([0.9, 0.5], jnp.float32),
+            jnp.zeros(2, jnp.int32),
+        )
+        assert int(out[0]) == 0  # argmax: first of the tied max entries
+        assert int(out[1]) < TOPP_FAST_K  # sampled row stays in-nucleus
 
 
 class TestDecodeLoop:
@@ -153,10 +258,11 @@ class TestGenerateChunks:
         assert got == want
 
     def test_seeded_stream_is_chunk_size_independent(self, tmp_path):
-        """One PRNG key threads through chunks, so temperature>0 streams are
-        identical for any chunk size AND identical to the single-dispatch
-        decode with the same seed (the round-2 advisor's reproducibility
-        complaint)."""
+        """Counter coins are keyed on (seed, position), so temperature>0
+        streams are identical for any chunk size AND identical to the
+        single-dispatch decode with the same seed — with zero sampler
+        state threading between dispatches (the round-2 advisor's
+        reproducibility complaint, now state-free per ISSUE 13)."""
         spec = tiny_spec()
         e1 = build_engine(tmp_path, spec)
         first = int(np.argmax(e1.prefill([2, 4])))
@@ -167,6 +273,23 @@ class TestGenerateChunks:
             e.prefill([2, 4])
             got = self._stream(
                 e, first, 9, temperature=0.9, topp=0.8, seed=13, chunk=chunk
+            )
+            assert got == want, f"chunk={chunk}"
+
+    def test_topk_stream_is_chunk_size_independent(self, tmp_path):
+        spec = tiny_spec()
+        e1 = build_engine(tmp_path, spec)
+        first = int(np.argmax(e1.prefill([2, 4])))
+        want = e1.generate_on_device(
+            first, 9, temperature=0.8, topp=0.0, seed=5, topk=4
+        ).tolist()
+
+        for chunk in (2, 9):
+            e = build_engine(tmp_path, spec)
+            e.prefill([2, 4])
+            got = self._stream(
+                e, first, 9, temperature=0.8, topp=0.0, seed=5, chunk=chunk,
+                topk=4,
             )
             assert got == want, f"chunk={chunk}"
 

@@ -33,7 +33,7 @@ def build_engine(tmp_path, name="model.m", seed=0, seq_len=96, cache_dtype=None)
 def plain_stream(engine, prompt, temp, topp, seed, n):
     """The non-speculative reference: prefill_device → chunked stream."""
     s = engine.new_stream()
-    first, key = s.prefill_device(prompt, temp, topp, seed)
+    first = s.prefill_device(prompt, temp, topp, seed)
     got = []
 
     def on_token(prev, tok):
@@ -41,13 +41,13 @@ def plain_stream(engine, prompt, temp, topp, seed, n):
         return len(got) < n
 
     s.stream_decode(first, on_token, temp, topp, seed=seed, chunk=4,
-                    limit=s.pos + n, key=key, first_prev=prompt[-1])
+                    limit=s.pos + n, first_prev=prompt[-1])
     return got
 
 
 def spec_stream(stream, prompt, temp, topp, seed, n, spec_draft=K):
     """The same request through the speculative path."""
-    first, key = stream.prefill_device(prompt, temp, topp, seed)
+    first = stream.prefill_device(prompt, temp, topp, seed)
     got = []
 
     def on_token(prev, tok):
@@ -55,7 +55,7 @@ def spec_stream(stream, prompt, temp, topp, seed, n, spec_draft=K):
         return len(got) < n
 
     stream.stream_decode(first, on_token, temp, topp, seed=seed,
-                         limit=stream.pos + n, key=key, first_prev=prompt[-1],
+                         limit=stream.pos + n, first_prev=prompt[-1],
                          spec_draft=spec_draft, prompt_tokens=prompt)
     return got
 
@@ -94,14 +94,15 @@ class TestPromptLookupDrafter:
 class TestSpecAccept:
     """The on-device accept/reject, unit-level (models.sampling)."""
 
-    def _accept(self, logits, draft, draft_len, key, temp, topp):
+    def _accept(self, logits, draft, draft_len, seed, temp, topp, topk=0, pos=0):
         from distributed_llama_tpu.models.sampling import _spec_accept_row
 
-        n, toks, k2 = _spec_accept_row(
+        n, toks = _spec_accept_row(
             jnp.asarray(logits, jnp.float32), jnp.asarray(draft, jnp.int32),
-            jnp.int32(draft_len), key, jnp.float32(temp), jnp.float32(topp),
+            jnp.int32(draft_len), jnp.uint32(seed), jnp.int32(pos),
+            jnp.float32(temp), jnp.float32(topp), jnp.int32(topk),
         )
-        return int(n), np.asarray(toks), k2
+        return int(n), np.asarray(toks), None
 
     def _greedy_logits(self, targets, vocab=16):
         out = np.full((len(targets), vocab), -5.0, np.float32)
@@ -111,24 +112,24 @@ class TestSpecAccept:
 
     def test_greedy_full_accept_emits_bonus(self):
         logits = self._greedy_logits([3, 6, 9, 12])
-        n, toks, _ = self._accept(logits, [3, 6, 9], 3, jax.random.PRNGKey(0), 0.0, 0.9)
+        n, toks, _ = self._accept(logits, [3, 6, 9], 3, 0, 0.0, 0.9)
         assert n == 4
         assert toks[:4].tolist() == [3, 6, 9, 12]  # drafts + bonus
 
     def test_greedy_rejection_emits_correction(self):
         logits = self._greedy_logits([3, 7, 9, 12])
-        n, toks, _ = self._accept(logits, [3, 6, 9], 3, jax.random.PRNGKey(0), 0.0, 0.9)
+        n, toks, _ = self._accept(logits, [3, 6, 9], 3, 0, 0.0, 0.9)
         assert n == 2  # d1 accepted, d2 rejected → correction 7
         assert toks[:2].tolist() == [3, 7]
 
     def test_greedy_immediate_rejection(self):
         logits = self._greedy_logits([5, 7, 9, 12])
-        n, toks, _ = self._accept(logits, [3, 6, 9], 3, jax.random.PRNGKey(0), 0.0, 0.9)
+        n, toks, _ = self._accept(logits, [3, 6, 9], 3, 0, 0.0, 0.9)
         assert n == 1 and toks[0] == 5
 
     def test_zero_draft_is_plain_step(self):
         logits = self._greedy_logits([5, 0, 0, 0])
-        n, toks, _ = self._accept(logits, [3, 6, 9], 0, jax.random.PRNGKey(0), 0.0, 0.9)
+        n, toks, _ = self._accept(logits, [3, 6, 9], 0, 0, 0.0, 0.9)
         assert n == 1 and toks[0] == 5
 
     def test_sampled_first_token_distribution_preserved(self):
@@ -142,15 +143,16 @@ class TestSpecAccept:
         from distributed_llama_tpu.models.sampling import _spec_accept_row
 
         accept = jax.jit(
-            lambda k: _spec_accept_row(
+            lambda seed: _spec_accept_row(
                 jnp.asarray(logits), jnp.asarray([2, 5], jnp.int32),
-                jnp.int32(2), k, jnp.float32(1.0), jnp.float32(1.0),
+                jnp.int32(2), seed, jnp.int32(0), jnp.float32(1.0),
+                jnp.float32(1.0), jnp.int32(0),
             )
         )
         counts = np.zeros(vocab)
         n_draws = 1500
         for i in range(n_draws):
-            _, toks, _ = accept(jax.random.PRNGKey(i))
+            _, toks = accept(jnp.uint32(i))
             counts[int(toks[0])] += 1
         np.testing.assert_allclose(counts / n_draws, target, atol=0.05)
 
@@ -164,13 +166,14 @@ class TestSpecAccept:
         from distributed_llama_tpu.models.sampling import _spec_accept_row
 
         accept = jax.jit(
-            lambda k: _spec_accept_row(
+            lambda seed: _spec_accept_row(
                 jnp.asarray(logits), jnp.asarray([0], jnp.int32), jnp.int32(1),
-                k, jnp.float32(1.0), jnp.float32(1.0),
+                seed, jnp.int32(0), jnp.float32(1.0), jnp.float32(1.0),
+                jnp.int32(0),
             )
         )
         accepted = sum(
-            int(accept(jax.random.PRNGKey(i))[0]) == 2 for i in range(1200)
+            int(accept(jnp.uint32(i))[0]) == 2 for i in range(1200)
         )
         np.testing.assert_allclose(accepted / 1200, p_draft, atol=0.05)
 

@@ -295,7 +295,7 @@ class TestEngineInstrumentation:
 
     def test_fused_prefill_defers_latency_to_fetch(self, enabled, tmp_path):
         engine = _tiny_engine(tmp_path)
-        first, _key = engine.prefill_device([1, 2, 3], temperature=0.0, topp=0.9, seed=0)
+        first = engine.prefill_device([1, 2, 3], temperature=0.0, topp=0.9, seed=0)
         reg = telemetry.REGISTRY
         # prompt tokens count at dispatch; the latency observation waits for
         # the first-token fetch (where the entry gains its drain time)

@@ -235,10 +235,10 @@ class TestTransferProbeDce:
         calls = []
         orig = e._tp_engine.measure_transfer_ms
         e._tp_engine.measure_transfer_ms = lambda *a, **k: calls.append(1) or orig()
-        tok, key = e.prefill_device([1, 2, 3], 0.0, 0.9, seed=0)
+        tok = e.prefill_device([1, 2, 3], 0.0, 0.9, seed=0)
         n = e.stream_decode(
             tok, lambda prev, t: True, 0.0, 0.9, chunk=4, limit=12,
-            key=key, first_prev=3,
+            first_prev=3,
         )
         assert n >= 1
         assert len(calls) >= 1, "fused flow must still measure the I/T split"
