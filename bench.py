@@ -15,6 +15,7 @@ CPU node — the same 1-device slot in the reference's scaling table.
 """
 
 import json
+import os
 import sys
 
 import numpy as np
@@ -39,6 +40,46 @@ def bench_metric(name: str, value: float, unit: str = "") -> float:
     )
     g.set(value)
     return g.value
+
+
+def params_hbm_bytes(params) -> int:
+    """Resident weight bytes one decode step reads (every param leaf once:
+    packed nibbles + scales for q40, raw array bytes otherwise — the
+    numerator of the decode roofline model). Embedding/rope rows are read
+    sparsely per token but included for a conservative (slightly high)
+    byte count; decode is weight-read dominated either way."""
+    import jax
+    import jax.numpy as jnp
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+# HBM peak for the roofline denominator: v5e ≈ 819 GB/s (docs/PERF.md's
+# profiled kernel numbers use the same figure). Override for other chip
+# generations; on a CPU host the fraction is reported but meaningless
+# (there is no 819 GB/s bus — the field exists so TPU runs gate on it).
+HBM_PEAK_GBPS = float(os.environ.get("DLT_HBM_GBPS", 819.0))
+
+
+def roofline_detail(n_bytes: int, tps: float, prefix: str = "") -> dict:
+    """The computed decode roofline: achieved HBM bytes/s = model bytes per
+    token × measured tok/s, as a fraction of peak — the kernel A/B gate as
+    a number in BENCH_*.json instead of prose (ISSUE 14)."""
+    achieved = n_bytes * tps
+    frac = achieved / (HBM_PEAK_GBPS * 1e9)
+    return {
+        f"{prefix}model_bytes_per_token": int(
+            bench_metric(f"{prefix}model_bytes_per_token", n_bytes, "bytes")),
+        f"{prefix}achieved_gbytes_per_sec": round(
+            bench_metric(f"{prefix}achieved_gbytes_per_sec", achieved / 1e9,
+                         "GB/s"), 3),
+        f"{prefix}roofline_fraction": round(
+            bench_metric(f"{prefix}roofline_fraction", frac), 4),
+        f"{prefix}hbm_peak_gbytes_per_sec": HBM_PEAK_GBPS,
+    }
 
 
 def llama2_7b_config(seq_len: int):
@@ -350,6 +391,10 @@ def run(cfg, name: str, prefill_len: int = 64, steps: int = 128, weights: str = 
         "unit": "tokens/sec",
         "vs_baseline": round(bench_metric("vs_baseline", tps / BASELINE_TPS), 2),
         "detail": {
+            # the decode roofline (ISSUE 14): achieved bytes/s from model
+            # bytes/token × measured tok/s vs the HBM peak — the kernel
+            # A/B gate as a number, not prose
+            **roofline_detail(params_hbm_bytes(params), tps),
             "ms_per_token": round(bench_metric("decode_ms_per_token", 1000.0 / tps, "ms"), 2),
             # the CLI/API fast path
             "chunked_decode_tokens_per_sec": round(
@@ -1361,6 +1406,168 @@ def run_prefix_cache(chaos: bool = False) -> dict:
     }
 
 
+def run_kernels() -> dict:
+    """``bench.py --kernels``: the ISSUE 14 Pallas-kernel A/B gate as one
+    committed JSON — each kernel measured against the path it replaces IN
+    THE SAME PROCESS with parity asserted, plus the computed roofline
+    fields for the matmul arms. On a CPU host the kernels run in Pallas
+    interpret mode: the timings are mechanism-relative (interpret has
+    per-op overhead the chip doesn't), the PARITY gates are authoritative,
+    and the roofline fractions are denominated against the v5e peak so the
+    TPU rerun drops into the same fields (chip numbers pending, the
+    BENCH_r0x convention)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.sampling import _pick_sorted, _topp_partition_pick
+    from distributed_llama_tpu.ops import attention as att
+    from distributed_llama_tpu.ops import collectives
+    from distributed_llama_tpu.ops.q40 import dequantize_tpu, q40_matmul, quantize_q40_tpu
+
+    rng = np.random.RandomState(0)
+    detail: dict = {"device": str(jax.devices()[0])}
+
+    def timed(fn, reps: int = 3) -> float:
+        np.asarray(fn())  # warm/compile
+        times = []
+        for _ in range(reps):
+            sw = Stopwatch()
+            np.asarray(fn())
+            times.append(sw.elapsed_ms())
+        return median(times)
+
+    # ---- q40 matmul: int8 MXU path vs f32-dequant kernel vs XLA fallback -
+    n, d, T = 4096, 4096, 1
+    w = rng.randn(n, d).astype(np.float32) / np.sqrt(n)
+    qm = quantize_q40_tpu(w)
+    x = jnp.asarray(rng.randn(T, n).astype(np.float32))
+    want = np.asarray(x @ jnp.asarray(dequantize_tpu(qm)))
+    arms = {}
+    for path in ("f32", "int8"):
+        fn = functools.partial(q40_matmul, x, qm, path=path)
+        got = np.asarray(fn())
+        rel = float(np.abs(got - want).max() / np.abs(want).max())
+        ms = timed(fn)
+        q40_bytes = params_hbm_bytes({"qs": qm.qs, "scales": qm.scales})
+        arms[path] = {
+            "ms": round(ms, 2),
+            "max_rel_err_vs_dequant": round(rel, 5),
+            **roofline_detail(q40_bytes, 1000.0 / ms, prefix=f"q40_{path}_"),
+        }
+        assert rel < 2e-2, f"q40 {path} kernel drifted from dequant: {rel}"
+    detail["q40_matmul"] = {
+        **arms,
+        "int8_vs_f32_speedup": round(
+            bench_metric("kernels_q40_int8_vs_f32", arms["f32"]["ms"] / arms["int8"]["ms"]), 3),
+        "shape": f"[{T},{n}]x[{n},{d}] q40, interleave off, interpret on CPU",
+    }
+
+    # ---- fused paged decode-attention vs the segmented-scan chain --------
+    B, S, K, M, hd, chunk, page, P_ = 4, 1024, 4, 2, 64, 512, 64, 32
+    qg = jnp.asarray(rng.randn(B, K, M, hd).astype(np.float32))
+    keys = jnp.asarray(rng.randn(B, S, K, hd).astype(np.float32))
+    values = jnp.asarray(rng.randn(B, S, K, hd).astype(np.float32))
+    pool_k = jnp.asarray(rng.randn(P_, page, K, hd).astype(np.float32))
+    pool_v = jnp.asarray(rng.randn(P_, page, K, hd).astype(np.float32))
+    tables = jnp.asarray(rng.randint(0, P_, (B, S // page)).astype(np.int32))
+    matched = jnp.asarray(np.array([512, 0, 384, 64], np.int32))
+    pos = jnp.asarray(np.array([900, 140, 700, 80], np.int32))
+    paged = (pool_k, pool_v, tables, matched)
+
+    def scan_arm():
+        prev = os.environ.get("DLT_FUSED_PAGED")
+        os.environ["DLT_FUSED_PAGED"] = "0"
+        try:
+            return att.batched_decode_attention(qg, keys, values, pos, chunk, paged=paged)
+        finally:
+            if prev is None:
+                os.environ.pop("DLT_FUSED_PAGED", None)
+            else:
+                os.environ["DLT_FUSED_PAGED"] = prev
+
+    def fused_arm():
+        return att.fused_paged_decode_attention(qg, keys, values, pos, chunk, paged)
+
+    ref, got = scan_arm(), fused_arm()
+    assert bool(jnp.all(ref == got)), "fused paged attention broke bit-parity"
+    scan_jit, fused_jit = jax.jit(scan_arm), jax.jit(fused_arm)
+    ms_scan, ms_fused = timed(scan_jit), timed(fused_jit)
+    detail["paged_attention"] = {
+        "segmented_scan_ms": round(ms_scan, 2),
+        "fused_kernel_ms": round(ms_fused, 2),
+        "fused_vs_scan_speedup": round(
+            bench_metric("kernels_fused_paged_vs_scan", ms_scan / ms_fused), 3),
+        "bit_identical": True,
+        "shape": f"B={B} S={S} chunk={chunk} page={page} f32, interpret on CPU",
+    }
+
+    # ---- ring all-reduce vs psum on the mesh ----------------------------
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_llama_tpu.ops.collectives import shard_map_compat
+
+    n_dev = len(jax.devices())
+    mesh = Mesh(mesh_utils.create_device_mesh((n_dev,)), ("tp",))
+    xa = jnp.asarray(rng.randn(1, 4096).astype(np.float32))
+
+    def wrap(impl):
+        return jax.jit(shard_map_compat(
+            lambda y: collectives.all_reduce(y, "tp", impl=impl),
+            mesh=mesh, in_specs=P(None, None), out_specs=P(None, None),
+        ))
+
+    f_psum, f_ring = wrap("psum"), wrap("ring_xla")
+    assert bool(jnp.all(f_psum(xa) == f_ring(xa))), "ring all-reduce != psum"
+    ms_psum = timed(lambda: f_psum(xa))
+    ms_ring = timed(lambda: f_ring(xa))
+    detail["all_reduce"] = {
+        "psum_ms": round(ms_psum, 3),
+        "ring_xla_ms": round(ms_ring, 3),
+        "bit_identical": True,
+        "devices": n_dev,
+        "note": "ring_xla = the ring schedule in XLA ppermute steps (the "
+        "CPU-mesh realization); the pallas remote-DMA ring compiles on "
+        "TPU only — its schedule is pinned by this parity",
+    }
+
+    # ---- partition-based bare-top-p vs the full-vocab sort ---------------
+    Bs, V = 8, 32000
+    logits = jnp.asarray(rng.randn(Bs, V).astype(np.float32) * 0.05)  # near-flat
+    probs = jax.nn.softmax(logits, axis=-1)
+    coin = jnp.asarray(rng.rand(Bs).astype(np.float32))
+    topp = jnp.full(Bs, 0.9, jnp.float32)
+    topk0 = jnp.zeros(Bs, jnp.int32)
+
+    @jax.jit
+    def sort_pick():
+        fi = jax.lax.top_k(logits, V)[1]
+        return _pick_sorted(jnp.take_along_axis(probs, fi, axis=-1), fi, coin, topp, topk0)
+
+    @jax.jit
+    def part_pick():
+        return _topp_partition_pick(probs, logits, coin, topp)
+
+    assert bool(jnp.all(sort_pick() == part_pick())), "partition top-p != full sort"
+    detail["topp_fallback"] = {
+        "full_sort_ms": round(timed(sort_pick), 2),
+        "partition_ms": round(timed(part_pick), 2),
+        "picks_identical": True,
+        "shape": f"B={Bs} V={V} near-flat logits (the overflow regime)",
+    }
+
+    speed = detail["q40_matmul"]["int8_vs_f32_speedup"]
+    return {
+        "metric": "pallas_kernel_ab_gates",
+        "value": speed,
+        "unit": "x (int8 MXU kernel vs f32 kernel, same shape/process)",
+        "vs_baseline": speed,
+        "detail": detail,
+    }
+
+
 def main_chaos(b: int):
     print(json.dumps(run_chaos(b)))
 
@@ -1478,6 +1685,14 @@ def main_single(weights: str):
 
 
 if __name__ == "__main__":
+    if "--kernels" in sys.argv and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        # the ring-vs-psum parity gate needs a mesh; give the host platform
+        # the same 8 virtual devices the test conftest uses (no effect on a
+        # real TPU platform — the flag only shapes the HOST device list)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
     # the cold-prefill metric measures what a fresh process pays: with the
     # persistent cache populated by a previous run, that is cache
     # deserialization, not a full XLA compile
@@ -1522,6 +1737,12 @@ if __name__ == "__main__":
         idx = sys.argv.index("--chaos")
         b = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 4
         main_chaos(b)
+    elif "--kernels" in sys.argv:
+        # Pallas kernel A/B gates (ISSUE 14): int8-MXU vs f32 q40 kernel,
+        # fused paged attention vs the segmented scan (bit-parity
+        # asserted), ring all-reduce vs psum, partition top-p vs full
+        # sort — committed as BENCH_KERNELS_*.json
+        print(json.dumps(run_kernels()))
     elif "--mixtral-only" in sys.argv:
         # multi-model probe (BASELINE config 3's shape class): one-chip
         # Mixtral-shaped MoE decode/prefill; not part of the default line —

@@ -139,8 +139,14 @@ def block_tail(
     out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
     if axis_name is not None:
         # the TP all-reduce: replaces gather + merge-add on root
-        # (reference: src/llama2-tasks.cpp:115-131) with one ICI collective
-        out = jax.lax.psum(out, axis_name)
+        # (reference: src/llama2-tasks.cpp:115-131) with one ICI collective.
+        # Routed through the all-reduce seam (ops.collectives): psum by
+        # default off-TPU, the bidirectional ring kernel on TPU — the ring
+        # overlaps the reduce with the matmul epilogue instead of fencing
+        # behind it
+        from distributed_llama_tpu.ops import collectives
+
+        out = collectives.all_reduce(out, axis_name)
     if cfg.arch.name == "GROK1":
         # grok rmsnorms the attention output with rmsFfn before the residual
         # add (reference: src/grok1-tasks.cpp:16-41)
@@ -289,7 +295,9 @@ def ffn(cfg: LlamaConfig, x: jax.Array, lp: Params, axis_name: str | None) -> ja
         h = _activation(_matmul(xn, lp["gate"]), cfg.hidden_act) * _matmul(xn, lp["up"])
     out = _matmul(h.astype(lp["down"].dtype), lp["down"])
     if axis_name is not None:
-        out = jax.lax.psum(out, axis_name)
+        from distributed_llama_tpu.ops import collectives
+
+        out = collectives.all_reduce(out, axis_name)
     return out
 
 

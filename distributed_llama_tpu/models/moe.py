@@ -274,7 +274,11 @@ def moe_ffn(
     else:
         out = _moe_dense(cfg, xn, lp, n_real=n_real)
     if axis_name is not None:
-        out = jax.lax.psum(out, axis_name)
+        # the MoE combine rides the same all-reduce seam as the dense FFN
+        # (ops.collectives: psum off-TPU, the ICI ring kernel on TPU)
+        from distributed_llama_tpu.ops import collectives
+
+        out = collectives.all_reduce(out, axis_name)
     return out
 
 

@@ -59,6 +59,17 @@ from distributed_llama_tpu.models.config import LlamaConfig
 # otherwise, so the result is EXACT either way
 TOPP_FAST_K = 128
 
+# vocab floor for the partition-based bare-top-p fallback: the bit-space
+# binary searches add ~400 ops to the decode program (a few seconds of XLA
+# compile per decode shape) and only beat the full sort where the sort is
+# actually expensive — production-width vocabularies (53× at V=32k,
+# BENCH_KERNELS_r07.json). Below the floor the routing — and therefore the
+# compiled program — is byte-identical to the pre-partition one: tiny test
+# models must not pay compile time for a path that would LOSE to their
+# cheap sort (a fresh multi-second compile mid-serving is exactly what the
+# preemption race tests schedule against).
+TOPP_PARTITION_MIN_V = 4096
+
 
 def _keep_count(vals, cum, topp, topk):
     """Kept-prefix width over descending candidates [rows, K]: the
@@ -99,6 +110,109 @@ def _pick_sorted(vals, idxs, coin, topp, topk):
     )
     pick = jnp.minimum(below, n_keep - 1)
     return jnp.take_along_axis(idxs, pick[:, None], axis=-1)[:, 0]
+
+
+def _desc_key(scaled: jax.Array) -> jax.Array:
+    """uint32 key monotone INCREASING in the f32 ``scaled`` logit (the
+    classic sign-flip bit trick), so value-threshold searches can walk key
+    bits instead of sorting: for non-negative floats the IEEE bits are
+    already ordered; negative floats order reversed, so flip all their
+    bits and set the sign bit on the rest."""
+    b = jax.lax.bitcast_convert_type(scaled.astype(jnp.float32), jnp.uint32)
+    return jnp.where(b >> 31 == 1, ~b, b | jnp.uint32(0x80000000))
+
+
+def _topp_partition_pick(probs, scaled, coin, topp):
+    """EXACT bare-top-p pick by partition (threshold) selection — no
+    full-vocab sort anywhere (the ROADMAP item 2 follow-up: near-flat
+    untrained-model-shaped logits overflow the ``TOPP_FAST_K`` window on
+    every step, and the old fallback paid a full-vocab ``top_k``).
+
+    Two 32-step binary searches over the f32 bit-space of the scaled
+    logits (each step one masked full-vocab sum — O(V log V_bits) adds vs
+    the sort's O(V log V) compare-exchanges, and no [V]-wide data
+    movement), both phrased against the canonical candidate order
+    (descending scaled logit, ties by lower id — `_keep_count`'s order):
+
+    1. the nucleus boundary VALUE: the largest key ``v`` whose at-or-above
+       mass still reaches ``topp`` (elements strictly above ``v`` are all
+       kept; ties AT ``v`` keep the id-ascending prefix while the mass
+       strictly before each stays < topp — the inclusive-crossing rule);
+    2. the PICK value for ``r = coin × kept_mass``: the largest key whose
+       strictly-above mass is ≤ r < at-or-above mass; the id-ascending
+       cumsum over the (rare) ties at that value resolves the pick, and
+       the result clamps to the last kept candidate exactly like
+       `_pick_sorted`'s saturating count.
+
+    Parity scope: identical to the full-sort `_pick_sorted` whenever no
+    cumulative mass lands within an ulp of a coin/topp crossing — the
+    masked sums here and the sorted prefix cumsum associate differently,
+    the same (documented) caveat the multinomial path carries.
+    Parity-tested against the sort path in tests/test_sampling.py."""
+    V = probs.shape[-1]
+    keys = _desc_key(scaled)
+
+    def mass_geq(v):
+        """Σ probs over candidates with key ≥ v (strictly-above plus ties)."""
+        return jnp.sum(jnp.where(keys >= v[:, None], probs, 0.0), axis=-1)
+
+    def bit_search(pred):
+        """Per-row largest uint32 v with pred(v) True (pred monotone
+        decreasing in v; pred(0) is True by construction)."""
+        v = jnp.zeros(probs.shape[0], jnp.uint32)
+        for k in range(31, -1, -1):
+            cand = v | jnp.uint32(1 << k)
+            v = jnp.where(pred(cand), cand, v)
+        return v
+
+    def succ(v):
+        """v + 1 saturating at the uint32 max (a wrap to 0 would turn
+        "strictly above the top key" into "everything")."""
+        return jnp.where(v == jnp.uint32(0xFFFFFFFF), v, v + 1)
+
+    topp = jnp.asarray(topp, jnp.float32)
+    # 1. boundary value: largest v with mass(key >= v) >= topp. mass_geq is
+    # a right-continuous step function constant between achieved key
+    # values, so v_b always LANDS on an achieved key — its tie set is
+    # non-empty, and (by maximality) the strictly-above mass is < topp, so
+    # the FIRST boundary tie is always kept: the kept prefix and the clamp
+    # target below are well defined with no empty-set cases.
+    v_b = bit_search(lambda v: mass_geq(v) >= topp)
+    above_b = mass_geq(succ(v_b))  # mass strictly above the boundary value
+    # ties at the boundary keep while (strictly-before mass) < topp; the
+    # id-order cumsum runs over the tie set only (rare — one key value)
+    tie_b = jnp.where(keys == v_b[:, None], probs, 0.0)
+    tiecum_b = jnp.cumsum(tie_b, axis=-1)
+    tie_kept = (keys == v_b[:, None]) & (
+        above_b[:, None] + (tiecum_b - tie_b) < topp[:, None]
+    )
+    kept_tie_mass = jnp.max(jnp.where(tie_kept, tiecum_b, 0.0), axis=-1)
+    total = above_b + kept_tie_mass  # the kept prefix's mass
+    strictly_above = keys > v_b[:, None]
+    # the clamp target = the LAST kept candidate in canonical order: the
+    # highest-cumsum kept boundary tie (argmax returns the first of equal
+    # cumsums — only reachable through zero-probability ties, which carry
+    # no mass either way)
+    last_kept = jnp.argmax(
+        jnp.where(tie_kept, tiecum_b, -1.0), axis=-1
+    ).astype(jnp.int32)
+
+    # 2. the draw: first candidate whose cumulative mass exceeds r
+    r = coin * total
+    v_p = bit_search(lambda v: mass_geq(v) > r)
+    above_p = mass_geq(succ(v_p))
+    tie_p = jnp.where(keys == v_p[:, None], probs, 0.0)
+    tiecum_p = jnp.cumsum(tie_p, axis=-1)
+    hit = (keys == v_p[:, None]) & (above_p[:, None] + tiecum_p > r[:, None])
+    found = jnp.any(hit, axis=-1)
+    pick = jnp.argmax(hit, axis=-1).astype(jnp.int32)  # first True = lowest id
+    pick = jnp.where(found, pick, last_kept)
+    # the pick must stay inside the kept prefix (r == total edge): kept
+    # means strictly above the boundary, or a kept boundary tie
+    in_kept = jnp.take_along_axis(
+        strictly_above | tie_kept, pick[:, None], axis=-1
+    )[:, 0]
+    return jnp.where(in_kept, pick, last_kept)
 
 
 def fused_pick(probs, scaled, coin, topp, topk, cand=None):
@@ -156,21 +270,38 @@ def fused_pick(probs, scaled, coin, topp, topk, cand=None):
         # sort when an in-window top-k also binds: the nucleus count is
         # then provably > window >= topk, so min(nucleus, topk) = topk and
         # the window has every kept candidate (_pick_sorted's counting
-        # saturates at the window, which is exactly right). Only a nucleus
-        # overflowing with no in-window top-k, or a top-k wider than the
-        # window, needs the full order.
+        # saturates at the window, which is exactly right). A BARE top-p
+        # whose nucleus overflows (near-flat, untrained-model-shaped
+        # logits) takes the exact partition-based selection — no
+        # full-vocab sort; only a top-k wider than the window still needs
+        # the full order.
         Kw = vals.shape[-1]
         cum_k = jnp.cumsum(vals, axis=-1)
         nucleus_unfit = topp_act & (cum_k[:, -1] < topp)
         wide_topk = topk_act & (topk > Kw)
         narrow_topk = topk_act & (topk <= Kw)
-        need_full = (nucleus_unfit & ~narrow_topk) | (~topp_act & wide_topk)
+        if V >= TOPP_PARTITION_MIN_V:
+            need_part = nucleus_unfit & ~topk_act
+            need_sort = wide_topk & (nucleus_unfit | ~topp_act)
+        else:
+            # small vocab: the sort is cheaper than the partition searches
+            # — keep the pre-partition routing (and the identical program)
+            need_part = None
+            need_sort = (nucleus_unfit & ~narrow_topk) | (~topp_act & wide_topk)
         tok_f = jax.lax.cond(
-            jnp.any(need_full),
+            jnp.any(need_sort),
             from_full,
             lambda _: _pick_sorted(vals, idxs, coin, topp, topk),
             None,
         )
+        if need_part is not None:
+            tok_p = jax.lax.cond(
+                jnp.any(need_part),
+                lambda _: _topp_partition_pick(probs, scaled, coin, topp),
+                lambda _: jnp.zeros((B,), jnp.int32),
+                None,
+            )
+            tok_f = jnp.where(need_part, tok_p, tok_f)
     return jnp.where(filt, tok_f, idx_m)
 
 

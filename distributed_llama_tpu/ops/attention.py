@@ -13,8 +13,12 @@ context entirely.
 
 from __future__ import annotations
 
+import os as _os
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from distributed_llama_tpu.ops import kv_cache as kvc
 
@@ -174,6 +178,34 @@ def blocked_partials(
     return jax.lax.fori_loop(0, n_chunks, body, (m0, l0, o0))
 
 
+def _decode_partial(qg, pos, chunk: int, cdt, prec):
+    """The per-chunk online-softmax arithmetic of the batched decode scan —
+    ONE definition consumed by both the XLA segmented scan and the fused
+    Pallas kernel body, so the two paths emit the identical op sequence on
+    identical chunk bytes (the mechanism behind their bit-parity)."""
+    hd = qg.shape[-1]
+
+    def partial(kc, vc, start, carry):
+        m, l, o = carry
+        k_pos = start + jnp.arange(chunk)
+        scores = kvc.scores_einsum_batched(qg.astype(cdt), kc, prec) / jnp.sqrt(
+            jnp.float32(hd)
+        )  # [B, K, M, chunk]
+        mask = (k_pos[None, :] <= pos[:, None])[:, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        ms = jnp.max(scores, axis=-1)
+        # keep m = -inf for fully-masked chunks (the exact-identity empty
+        # partial — see merge_partials); exp still needs a finite reference
+        safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(mask, p, 0.0)
+        ls = jnp.sum(p, axis=-1)
+        os_ = kvc.mix_einsum_batched(p, vc, cdt, prec)
+        return merge_partials(m, l, o, ms, ls, os_)
+
+    return partial
+
+
 def batched_decode_attention(
     qg: jax.Array,  # [B, K, M, hd] f32 grouped queries (one token per row)
     keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
@@ -203,29 +235,22 @@ def batched_decode_attention(
     the virtual-row einsum otherwise)."""
     B, K, M, hd = qg.shape
     S = keys.shape[1]
+    if paged is not None and _fused_paged_eligible(qg, keys, values, paged, chunk):
+        from distributed_llama_tpu import telemetry
+
+        telemetry.note_kernel_path("paged_attention", "pallas_fused")
+        return fused_paged_decode_attention(qg, keys, values, pos, chunk, paged)
+    if paged is not None:
+        from distributed_llama_tpu import telemetry
+
+        # the hit path fell back to the chain of segmented-scan programs —
+        # visible in /metrics so a silent slow path can be alerted on
+        telemetry.note_kernel_path("paged_attention", "xla_segmented")
     cdt = kvc.compute_dtype(keys)
     prec = kvc.einsum_precision(keys)
     live = jnp.clip(jnp.max(pos) + 1, 0, S)
     n_chunks = jax.lax.div(live + chunk - 1, chunk)
-
-    def partial(kc, vc, start, carry):
-        m, l, o = carry
-        k_pos = start + jnp.arange(chunk)
-        scores = kvc.scores_einsum_batched(qg.astype(cdt), kc, prec) / jnp.sqrt(
-            jnp.float32(hd)
-        )  # [B, K, M, chunk]
-        mask = (k_pos[None, :] <= pos[:, None])[:, None, None, :]
-        scores = jnp.where(mask, scores, -jnp.inf)
-        ms = jnp.max(scores, axis=-1)
-        # keep m = -inf for fully-masked chunks (the exact-identity empty
-        # partial — see merge_partials); exp still needs a finite reference
-        safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
-        p = jnp.exp(scores - safe_m[..., None])
-        p = jnp.where(mask, p, 0.0)
-        ls = jnp.sum(p, axis=-1)
-        os_ = kvc.mix_einsum_batched(p, vc, cdt, prec)
-        return merge_partials(m, l, o, ms, ls, os_)
-
+    partial = _decode_partial(qg, pos, chunk, cdt, prec)
     m0 = jnp.full((B, K, M), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, K, M), jnp.float32)
     o0 = jnp.zeros((B, K, M, hd), jnp.float32)
@@ -233,6 +258,204 @@ def batched_decode_attention(
         partial, keys, values, paged, chunk, n_chunks, (m0, l0, o0), rows=B
     )
     return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Fused paged decode-attention (ROADMAP item 1): ONE Pallas program replaces
+# the chain of separate XLA programs the segmented scan compiles on the
+# prefix-hit path (per-segment fori_loops, per-chunk pool gathers, select,
+# einsums, merges — each a separate HLO loop body with its own HBM round
+# trips for the m/l/o carries). The kernel walks the SAME chunk indices in
+# the SAME three segments (pool-only / mixed / slab-only — zero pool
+# traffic on slab-only chunks, exactly like the scan), assembles each
+# chunk's KV bytes with explicit async DMA into VMEM scratch (slab slice,
+# or per-page copies routed through the row's page table), and runs the
+# SHARED per-chunk arithmetic (:func:`_decode_partial`) with the online-
+# softmax carries resident on-chip — so the merge math is the identical op
+# sequence on identical bytes and the output is BIT-IDENTICAL to the
+# segmented scan's (the EXACT-EMPTY-PARTIAL semantics ride along for free;
+# test-enforced across bf16/f32/i8 and bucket shapes in
+# tests/test_kernel_parity.py).
+#
+# Compiled-mode notes: operands sit in ANY (HBM) memory space, chunks are
+# DMA'd into VMEM scratch, page tables/ids read from SMEM — the Mosaic-
+# shaped structure. The DMAs are issued serially (start+wait per copy);
+# double-buffering the next chunk's loads behind the current chunk's
+# einsums is the named headroom (docs/PERF.md). The authoritative gate in
+# this tree is interpret-mode bit-parity on the CPU mesh — the container's
+# jax cannot compile Mosaic.
+# ---------------------------------------------------------------------------
+
+
+def _fused_paged_enabled() -> bool:
+    """Default: ON where the kernel runs interpreted (CPU — the fully
+    parity-gated mode), OFF on accelerators until a chip smoke validates
+    the Mosaic build (a compiled-mode lowering failure would surface at
+    XLA compile of the whole decode program, past any dispatch-level
+    fallback — the same prudence as the ring all-reduce default).
+    ``DLT_FUSED_PAGED`` overrides either way; read per dispatch decision
+    (trace time)."""
+    env = _os.environ.get("DLT_FUSED_PAGED")
+    if env is not None:
+        return env != "0"
+    return jax.devices()[0].platform == "cpu"
+
+
+def _fused_paged_eligible(qg, keys, values, paged, chunk: int) -> bool:
+    """Shape/dtype gate for the fused kernel: slab and pool halves must
+    agree on quantization class, chunks must be whole pages, and the slab
+    must block evenly (callers already guarantee the last two on the
+    production path — the checks make the fallback safe, not rare)."""
+    if not _fused_paged_enabled():
+        return False
+    pool_k, pool_v, tables, matched = paged
+    quant = isinstance(keys, kvc.QuantizedKV)
+    if any(
+        isinstance(h, kvc.QuantizedKV) is not quant
+        for h in (values, pool_k, pool_v)
+    ):
+        return False
+    page = kvc.pool_page_size(pool_k)
+    S = keys.shape[1]
+    return chunk % page == 0 and S % chunk == 0
+
+
+def fused_paged_decode_attention(
+    qg: jax.Array,  # [B, K, M, hd] f32 grouped queries (one token per row)
+    keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
+    values,
+    pos: jax.Array,  # [B] per-row absolute positions
+    chunk: int,
+    paged,  # (pool_k, pool_v, tables [B, n_table], matched [B])
+    interpret: bool | None = None,
+) -> jax.Array:
+    """The fused Pallas form of the paged :func:`batched_decode_attention`
+    hit path — same segment split, same chunk order, same merge arithmetic,
+    bit-identical output. Returns [B, K, M, hd] f32."""
+    from distributed_llama_tpu.ops.q40 import tpu_compiler_params
+
+    pool_k, pool_v, tables, matched = paged
+    B, K, M, hd = qg.shape
+    S = keys.shape[1]
+    quant = isinstance(keys, kvc.QuantizedKV)
+    page = kvc.pool_page_size(pool_k)
+    ppc = chunk // page
+    n_table = tables.shape[1]
+    nh = 2 if quant else 1
+    cdt = kvc.compute_dtype(keys)
+    prec = kvc.einsum_precision(keys)
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    def halves(h):
+        return (h.data, h.scales) if quant else (h,)
+
+    def scratch_for(h, n_rows: int):
+        """VMEM chunk-scratch shapes mirroring one source's halves."""
+        if quant:
+            return [
+                pltpu.VMEM((n_rows, chunk, K, hd), h.data.dtype),
+                pltpu.VMEM((n_rows, chunk, K, 1), h.scales.dtype),
+            ]
+        return [pltpu.VMEM((n_rows, chunk, K, hd), h.dtype)]
+
+    def kernel(*refs):
+        pos_ref, matched_ref, tables_ref, qg_ref = refs[:4]
+        body = refs[4 : 4 + 4 * nh]
+        out_ref = refs[4 + 4 * nh]
+        scr = refs[5 + 4 * nh :]
+        slab_k, slab_v = body[:nh], body[nh : 2 * nh]
+        pk, pv = body[2 * nh : 3 * nh], body[3 * nh : 4 * nh]
+        sk_scr, sv_scr = scr[:nh], scr[nh : 2 * nh]
+        pk_scr, pv_scr = scr[2 * nh : 3 * nh], scr[3 * nh : 4 * nh]
+        sem = scr[4 * nh]
+
+        def copy(src, dst):
+            c = pltpu.make_async_copy(src, dst, sem)
+            c.start()
+            c.wait()
+
+        def load_slab(start):
+            # one sliced DMA per half: the first B slab rows' chunk window
+            # (a dispatch bucket below B_max reads only its own rows,
+            # mirroring kvc.slice_rows_batched(rows=B))
+            for r, s in zip(slab_k, sk_scr):
+                copy(r.at[pl.ds(0, B), pl.ds(start, chunk)], s)
+            for r, s in zip(slab_v, sv_scr):
+                copy(r.at[pl.ds(0, B), pl.ds(start, chunk)], s)
+
+        def load_pool(i):
+            # page-table-routed copies: page p of chunk i for row b comes
+            # from pool page tables[b, i*ppc + p]. The table window start
+            # clamps exactly like the scan's lax.dynamic_slice on tables.
+            base = jnp.clip(i * ppc, 0, n_table - ppc)
+            for b in range(B):
+                for p in range(ppc):
+                    pid = tables_ref[b, base + p]
+                    for r, s in zip(pk, pk_scr):
+                        copy(r.at[pid], s.at[b, pl.ds(p * page, page)])
+                    for r, s in zip(pv, pv_scr):
+                        copy(r.at[pid], s.at[b, pl.ds(p * page, page)])
+
+        def read(scrs):
+            if quant:
+                return kvc.QuantizedKV(scrs[0][:], scrs[1][:])
+            return scrs[0][:]
+
+        pos_ = pos_ref[:]
+        matched_ = matched_ref[:]
+        partial = _decode_partial(qg_ref[:], pos_, chunk, cdt, prec)
+        live = jnp.clip(jnp.max(pos_) + 1, 0, S)
+        n_chunks = jax.lax.div(live + chunk - 1, chunk)
+        a, b_seg = paged_segments(matched_, chunk, n_chunks)
+
+        def body_pool(i, carry):
+            load_pool(i)
+            return partial(read(pk_scr), read(pv_scr), i * chunk, carry)
+
+        def body_mixed(i, carry):
+            load_slab(i * chunk)
+            load_pool(i)
+            sel = (i * chunk + jnp.arange(chunk))[None, :] < matched_[:, None]
+            kc = kvc.select_kv(sel, read(pk_scr), read(sk_scr))
+            vc = kvc.select_kv(sel, read(pv_scr), read(sv_scr))
+            return partial(kc, vc, i * chunk, carry)
+
+        def body_slab(i, carry):
+            load_slab(i * chunk)
+            return partial(read(sk_scr), read(sv_scr), i * chunk, carry)
+
+        m0 = jnp.full((B, K, M), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, K, M), jnp.float32)
+        o0 = jnp.zeros((B, K, M, hd), jnp.float32)
+        carry = jax.lax.fori_loop(0, a, body_pool, (m0, l0, o0))
+        carry = jax.lax.fori_loop(a, b_seg, body_mixed, carry)
+        m, l, o = jax.lax.fori_loop(b_seg, n_chunks, body_slab, carry)
+        out_ref[:] = o / jnp.maximum(l, 1e-30)[..., None]
+
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = (
+        [any_spec, any_spec, pl.BlockSpec(memory_space=pltpu.SMEM), any_spec]
+        + [any_spec] * (4 * nh)
+    )
+    scratch = (
+        scratch_for(keys, B) + scratch_for(values, B)
+        + scratch_for(pool_k, B) + scratch_for(pool_v, B)
+        + [pltpu.SemaphoreType.DMA]
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, K, M, hd), jnp.float32),
+        in_specs=in_specs,
+        out_specs=any_spec,
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **tpu_compiler_params(),
+    )(
+        pos.astype(jnp.int32), matched.astype(jnp.int32),
+        tables.astype(jnp.int32), qg,
+        *halves(keys), *halves(values), *halves(pool_k), *halves(pool_v),
+    )
 
 
 def batched_verify_attention(

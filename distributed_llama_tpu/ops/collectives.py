@@ -1,0 +1,312 @@
+"""The all-reduce seam + ICI ring all-reduce kernel (ROADMAP item 1).
+
+Every tensor-parallel layer pays exactly two all-reduces (after wo and
+after down — ``models.llama.block_tail``/``ffn``; the reference's two
+gather+merge TCP hops per layer, src/llama2-tasks.cpp:115-131/196-212).
+XLA lowers ``lax.psum`` to its own fused all-reduce, which SERIALIZES
+after the matmul producing its operand: the collective cannot start until
+the full [T, dim] product lands, and nothing overlaps the wire time. The
+ring kernel here (`ring_all_reduce`, per SNIPPETS.md [1] /
+docs.jax.dev pallas distributed) instead runs reduce-scatter + all-gather
+as explicit bidirectional ``make_async_remote_copy`` steps, so on TPU the
+per-chunk sends overlap the remaining chunks' adds — and, fused into the
+same Mosaic program as a consumer, the matmul epilogue — instead of
+fencing behind them.
+
+Determinism contract (the reason this is NOT a naive rotate-and-add
+ring): each output chunk's sum is accumulated ONCE, on the shard the
+reduce-scatter assigns it, in a FIXED ring order, then broadcast verbatim
+by the all-gather — so every shard holds byte-identical results, exactly
+like ``psum`` (a rotate-and-add ring would give each shard a different
+f32 association of the same addends, and replicated sampling would
+diverge across shards).
+
+Three implementations behind one seam (:func:`all_reduce`):
+
+* ``psum``     — ``jax.lax.psum``, the default off-TPU (and the safety
+                 net everywhere: any ring-path build failure falls back).
+* ``ring_xla`` — the ring SCHEDULE via ``lax.ppermute`` steps: the same
+                 chunk walk without Pallas, runnable on the CPU test mesh
+                 (the container's jax cannot interpret remote DMA — the
+                 version-gate/soft-fallback policy of the tp clamp), and
+                 the parity reference for the kernel's schedule.
+* ``ring``     — the Pallas remote-DMA kernel, TPU compiled mode only.
+
+``DLT_ALLREDUCE`` pins an implementation (``psum`` / ``ring_xla`` /
+``ring``); unset, EVERY platform defaults to psum for now — the ring
+kernel has never been Mosaic-compiled (no chip in this tree's CI) and a
+lowering failure would surface at XLA compile of the whole jitted
+forward, past any fallback; flipping the TPU default is the first chip-
+validation follow-up (ROADMAP item 1). Every selection is counted in
+``dllama_kernel_path_total{kernel="all_reduce"}`` so the implementation
+actually serving is visible in /metrics.
+"""
+
+from __future__ import annotations
+
+import os as _os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across the jax versions this tree supports: current jax
+    wants ``jax.shard_map(check_vma=False)``, the container's 0.4.37 only
+    has ``jax.experimental.shard_map.shard_map(check_rep=False)``. The
+    production backends keep their pinned ``check_vma`` call (the known
+    env-failure ceiling); NEW collective tests/benches use this compat so
+    the ring parity gates run everywhere."""
+    try:
+        from jax import shard_map as _sm  # type: ignore
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+def _axis_size(axis_name: str) -> int | None:
+    """Static size of a named mesh axis during a shard_map trace, across
+    the jax versions this tree supports; None when unresolvable (→ psum)."""
+    try:
+        fr = jax.core.axis_frame(axis_name)  # returns the int itself on 0.4.x
+        return int(getattr(fr, "size", fr))
+    except Exception:
+        pass
+    try:
+        from jax._src.core import get_axis_env
+
+        return int(get_axis_env().axis_size(axis_name))
+    except Exception:
+        return None
+
+
+def _note(path: str) -> None:
+    from distributed_llama_tpu import telemetry
+
+    telemetry.note_kernel_path("all_reduce", path)
+
+
+def default_impl() -> str:
+    """psum unless ``DLT_ALLREDUCE`` pins otherwise — INCLUDING on TPU for
+    now: the ring kernel has never been Mosaic-compiled (no chip in this
+    tree's CI), and the seam's try/except can only catch TRACE-time
+    failures — a Mosaic lowering error surfaces later, at XLA compile of
+    the whole jitted forward, where no fallback can run. Flipping the TPU
+    default to "ring" is the first item of the chip-validation follow-up
+    (ROADMAP item 1); until then the kernel is an explicit opt-in."""
+    return _os.environ.get("DLT_ALLREDUCE") or "psum"
+
+
+def all_reduce(x: jax.Array, axis_name: str | None, impl: str | None = None) -> jax.Array:
+    """THE all-reduce seam: sum ``x`` over ``axis_name`` replicated-
+    identically on every shard. ``axis_name=None`` is the single-chip
+    no-op, mirroring the psum call sites it replaces."""
+    if axis_name is None:
+        return x
+    if impl is None:
+        impl = default_impl()
+    if impl in ("ring", "ring_xla"):
+        n = _axis_size(axis_name)
+        if n is None or n <= 1 or x.shape[-1] < n:
+            impl = "psum"  # tiny/odd payloads: the ring buys nothing
+    if impl == "ring":
+        try:
+            out = ring_all_reduce(x, axis_name, n)
+            _note("ici_ring")
+            return out
+        except Exception:
+            # version-gated Pallas surface missing (or the kernel failed to
+            # trace): the collective must not take the program down
+            impl = "psum"
+    if impl == "ring_xla":
+        _note("ring_xla")
+        return ring_all_reduce_xla(x, axis_name, n)
+    _note("psum")
+    return lax.psum(x, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Ring schedule via ppermute (the CPU-mesh realization + parity reference)
+# ---------------------------------------------------------------------------
+
+
+def _ring_chunks(x: jax.Array, n: int):
+    """Split the last axis into n equal chunks (zero-padded), stacked on a
+    leading axis: [n, ..., ceil(d/n)]."""
+    d = x.shape[-1]
+    pad = (-d) % n
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return jnp.stack(jnp.split(x, n, axis=-1)), pad
+
+
+def ring_all_reduce_xla(x: jax.Array, axis_name: str, n: int) -> jax.Array:
+    """Ring all-reduce as N-1 reduce-scatter + N-1 all-gather ppermute
+    steps — the exact chunk schedule of the Pallas kernel, expressed in
+    XLA collectives. Each chunk c accumulates in the fixed ring order
+    (c, c+1, ..., c+n-1) on its owner, so all shards end byte-identical.
+    Runnable on the CPU test mesh; the parity gate vs psum lives in
+    tests/test_kernel_parity.py."""
+    orig = x.shape[-1]
+    chunks, pad = _ring_chunks(x, n)
+    me = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # reduce-scatter: at step s, each shard forwards the partial it holds
+    # and folds its local copy of the chunk arriving next; after n-1 steps
+    # shard i owns the full sum of chunk (i + 1) mod n
+    partial = jnp.take(chunks, me % n, axis=0)
+    for s in range(1, n):
+        partial = lax.ppermute(partial, axis_name, perm)
+        partial = partial + jnp.take(chunks, (me - s) % n, axis=0)
+
+    # all-gather: circulate the owned chunks; shard i receives chunk
+    # (i - s + 1) mod n at step s and writes it at its global index
+    out = jnp.zeros_like(chunks)
+    cur = partial
+    out = lax.dynamic_update_index_in_dim(out, cur, (me + 1) % n, 0)
+    for s in range(1, n):
+        cur = lax.ppermute(cur, axis_name, perm)
+        out = lax.dynamic_update_index_in_dim(out, cur, (me - s + 1) % n, 0)
+
+    flat = jnp.concatenate(list(out), axis=-1)
+    return flat[..., :orig] if pad else flat
+
+
+# ---------------------------------------------------------------------------
+# Pallas remote-DMA ring kernel (TPU compiled mode)
+# ---------------------------------------------------------------------------
+#
+# Bidirectional ring per the pallas distributed guide: the chunk axis is
+# split into two halves, one walked clockwise and one counter-clockwise, so
+# both ICI directions carry payload and the per-step wire time halves. Each
+# direction runs the same reduce-scatter (+ all-gather) schedule as
+# ring_all_reduce_xla. The remote copies are started as soon as a partial
+# is ready — on TPU the next chunk's local add (and the surrounding
+# program's epilogue) proceeds while the copy is in flight, which is the
+# overlap psum structurally cannot give. The container's jax cannot
+# interpret make_async_remote_copy (version gate), so this path is
+# TPU-compiled-only; the schedule itself is pinned by the ring_xla parity
+# tests and the two share their chunk arithmetic by construction.
+
+
+def _make_ring_kernel(axis_name: str, n: int):
+    """Kernel factory for the bidirectional ring. Chunk layout: ref index
+    ``2*c + d`` holds ring ``d``'s chunk at ring position ``c`` (d = 0
+    clockwise, d = 1 counter-clockwise), so both ICI directions carry half
+    the payload. The two rings advance TOGETHER each step with both remote
+    copies in flight concurrently — each direction's wire time hides under
+    the other's wait+add, which is where the bidirectional win actually
+    comes from (two sequential half-payload rings would just re-serialize
+    it). Per ring, the schedule is IDENTICAL to
+    :func:`ring_all_reduce_xla`'s (that parity is what the CPU-mesh tests
+    pin): reduce-scatter accumulates chunk c in fixed ring order on its
+    owner, then the all-gather circulates the owned chunks verbatim."""
+
+    def kernel(chunks_ref, out_ref, comm_ref, scratch_ref, send_sem, recv_sem):
+        my = lax.axis_index(axis_name)
+        neighbor = (jnp.mod(my + 1, n), jnp.mod(my - 1, n))  # cw, ccw
+
+        def start_hop(d, slot, value):
+            """Stage ``value`` and start its copy to ring ``d``'s
+            neighbor; the caller waits AFTER both rings' copies are in
+            flight."""
+            scratch_ref[d, slot] = value
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=scratch_ref.at[d, slot],
+                dst_ref=comm_ref.at[d, slot],
+                send_sem=send_sem.at[d],
+                recv_sem=recv_sem.at[d],
+                device_id=(neighbor[d],),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            return rdma
+
+        def both_hops(slot, v_cw, v_ccw):
+            r0 = start_hop(0, slot, v_cw)
+            r1 = start_hop(1, slot, v_ccw)  # both directions in flight
+            r0.wait()
+            r1.wait()
+            return comm_ref[0, slot], comm_ref[1, slot]
+
+        def local_chunk(d, c):
+            return pl.load(chunks_ref, (2 * c + d,))
+
+        def rs_step(s, carry):
+            p_cw, p_ccw = carry
+            got_cw, got_ccw = both_hops(s % 2, p_cw, p_ccw)
+            return (
+                got_cw + local_chunk(0, jnp.mod(my - s, n)),
+                got_ccw + local_chunk(1, jnp.mod(my + s, n)),
+            )
+
+        p_cw, p_ccw = lax.fori_loop(
+            1, n, rs_step, (local_chunk(0, my), local_chunk(1, my))
+        )
+        pl.store(out_ref, (2 * jnp.mod(my + 1, n),), p_cw)
+        pl.store(out_ref, (2 * jnp.mod(my - 1, n) + 1,), p_ccw)
+
+        def ag_step(s, carry):
+            c_cw, c_ccw = carry
+            got_cw, got_ccw = both_hops(s % 2, c_cw, c_ccw)
+            pl.store(out_ref, (2 * jnp.mod(my - s + 1, n),), got_cw)
+            pl.store(out_ref, (2 * jnp.mod(my + s - 1, n) + 1,), got_ccw)
+            return got_cw, got_ccw
+
+        lax.fori_loop(1, n, ag_step, (p_cw, p_ccw))
+
+    return kernel
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str, n: int) -> jax.Array:
+    """Bidirectional Pallas remote-DMA ring all-reduce over ``axis_name``
+    (TPU compiled mode only; see the module note on why the container
+    cannot run it interpreted — any TRACE-time failure falls back to psum
+    via :func:`all_reduce`, and the decode payloads are small enough that
+    every operand sits in VMEM)."""
+    from distributed_llama_tpu.ops.q40 import tpu_compiler_params
+
+    params = tpu_compiler_params(has_side_effects=True, collective_id=0)
+    if not params:
+        # has_side_effects/collective_id are CORRECTNESS-critical for a
+        # cross-device DMA kernel (DCE/reordering and the rendezvous id),
+        # not droppable hints: a jax whose params class can't express them
+        # must not run the ring at all (the seam converts this to psum)
+        raise RuntimeError(
+            "pallas compiler params lack has_side_effects/collective_id; "
+            "refusing to build the ring kernel without them"
+        )
+    orig_shape = x.shape
+    d = x.shape[-1]
+    # 2n chunks: index 2c+0 rides the clockwise ring, 2c+1 the counter ring
+    pad = (-d) % (2 * n)
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    flat = x.reshape(-1, x.shape[-1])
+    chunks = jnp.stack(jnp.split(flat, 2 * n, axis=-1))  # [2n, rows, d/2n]
+    slot = (2, 2) + chunks.shape[1:]
+    out = pl.pallas_call(
+        _make_ring_kernel(axis_name, n),
+        out_shape=jax.ShapeDtypeStruct(chunks.shape, chunks.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM(slot, chunks.dtype),  # recv slots (remote writes)
+            pltpu.VMEM(slot, chunks.dtype),  # send staging
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        **params,
+    )(chunks)
+    flat_out = jnp.concatenate(list(out), axis=-1)
+    flat_out = flat_out[..., :d] if pad else flat_out
+    return flat_out.reshape(orig_shape)

@@ -51,6 +51,37 @@ BLOCK_D = int(_os.environ.get("DLT_BD", 2048))  # output tile (multiple of 128;
 # 2048 profiled ~4% faster than 1024 on v5e decode; T>8 shrinks it for VMEM)
 
 
+# The pallas compiler-params class moved names across jax releases
+# (CompilerParams on current jax, TPUCompilerParams on the container's
+# 0.4.37); resolve whichever exists ONCE and soft-fall-back to no params —
+# a missing class must cost the dimension-semantics hint, never the kernel
+# (the same version-gate policy as the shard_map check_vma clamp).
+_COMPILER_PARAMS_CLS = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+
+def tpu_compiler_params(**kw) -> dict:
+    """kwargs for ``pl.pallas_call``: ``{"compiler_params": ...}`` when the
+    running jax exposes the class, ``{}`` otherwise (interpret mode ignores
+    the params anyway, so the gate only changes what compiled TPU builds
+    see)."""
+    if _COMPILER_PARAMS_CLS is None:
+        return {}
+    try:
+        return {"compiler_params": _COMPILER_PARAMS_CLS(**kw)}
+    except TypeError:  # a param this jax's class doesn't know
+        return {}
+
+
+def _note_path(kernel: str, path: str) -> None:
+    """Count one kernel-dispatch decision (trace-time — once per compiled
+    program, not per token; docs/OBSERVABILITY.md `dllama_kernel_path_total`)."""
+    from distributed_llama_tpu import telemetry
+
+    telemetry.note_kernel_path(kernel, path)
+
+
 def _validate_env_tiles() -> None:
     """Validates the DLT_BN/DLT_BD env overrides at first kernel use, not
     import time: a bad tuning value must fail pointing at the knob, not make
@@ -469,39 +500,98 @@ def _make_q40_kernel(compute_dtype, interleaved: bool = False, interpret: bool =
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
+def _resolve_tiles(qm: QuantizedMatrix, T: int, block_n: int, block_d: int):
+    """The kernel-eligibility decision, shared by every path: (bn, bd)
+    tiles dividing the padded dims, or None → the XLA fallback. block_n
+    granule 512: the x window (T, bn/2) needs bn/2 % 128 == 0 and the
+    scales tile (bn/64, bd) needs bn/64 % 8 == 0 (mosaic sublane/lane
+    tiling rules) — smaller matrices take the XLA fallback."""
+    _validate_env_tiles()
+    block_d = _shrink_block_d(T, block_d)
+    if qm.interleaved:
+        # the row interleave was built for exactly this window; any other
+        # block_n would pair wrong scales with wrong rows
+        block_n = qm.packed_bn
+    else:
+        block_n = _largest_divisor_tile(qm.n_padded, block_n, 512)
+    block_d = _largest_divisor_tile(qm.d_padded, block_d, 128)
+    if block_n is None or block_d is None:
+        return None
+    return block_n, block_d
+
+
+def default_q40_path() -> str:
+    """The q40 kernel path when the caller doesn't pin one: the int8 MXU
+    Q40×Q80 kernel where it runs interpreted (CPU — the parity-gated
+    mode), the chip-proven f32-dequant kernel on accelerators until a
+    chip smoke validates the int8 Mosaic build (its per-block batched
+    ``dot_general`` has never been lowered on hardware; a failure would
+    surface at XLA compile of the whole decode program, past any
+    fallback — the same prudence as the fused-attention and ring
+    defaults). ``DLT_Q40_INT8=1`` opts the int8 kernel in anywhere,
+    ``=0`` pins f32. Read per dispatch decision (trace time)."""
+    env = _os.environ.get("DLT_Q40_INT8")
+    if env is not None:
+        return "int8" if env != "0" else "f32"
+    return "int8" if jax.devices()[0].platform == "cpu" else "f32"
+
+
 def q40_matmul(
     x: jax.Array,
     qm: QuantizedMatrix,
     block_n: int = BLOCK_N,
     block_d: int = BLOCK_D,
     interpret: bool | None = None,
+    path: str | None = None,
 ) -> jax.Array:
-    """y[T, d] = x[T, n] @ dequant(qm), f32 accumulation. ``n``/``d`` are the
-    logical dims; internally the kernel runs on the padded arrays (zero-scale
-    padding → exact-zero contributions) and trims the output."""
-    n, d = qm.n, qm.d
-    np_, dp = qm.n_padded, qm.d_padded
-    T = x.shape[0]
-    _validate_env_tiles()
-    block_d = _shrink_block_d(T, block_d)
-    # tiles must divide the (padded) dims; block_n granule 512: the x window
-    # (T, bn/2) needs bn/2 % 128 == 0 and the scales tile (bn/64, bd) needs
-    # bn/64 % 8 == 0 (mosaic sublane/lane tiling rules) — smaller matrices
-    # take the XLA fallback
-    if qm.interleaved:
-        # the row interleave was built for exactly this window; any other
-        # block_n would pair wrong scales with wrong rows
-        block_n = qm.packed_bn
-    else:
-        block_n = _largest_divisor_tile(np_, block_n, 512)
-    block_d = _largest_divisor_tile(dp, block_d, 128)
-    if block_n is None or block_d is None:
-        return _q40_matmul_fallback(x, qm)
+    """y[T, d] = x[T, n] @ dequant(qm), f32 accumulation — the ONE Q40
+    matmul entry point (``models.llama._matmul`` routes every quantized
+    weight through here). Dispatches between three implementations behind
+    one signature:
+
+    * ``"int8"`` (default): the int8 MXU kernel — activations quantized to
+      Q80 (per-32-block int8 + f32 scale), per-block exact int32
+      accumulation on the MXU, scale-product epilogue (ROADMAP item 1).
+    * ``"f32"``: the round-5 VPU-dequant kernel (nibbles cast+scaled in
+      VMEM, bf16 MXU dots) — the fallback path for the int8 A/B.
+    * XLA fallback for matrices too small/odd to tile (either ``path``).
+
+    Every dispatch decision is counted in ``dllama_kernel_path_total``
+    (mxu_int8 / vpu_f32 / xla_fallback) so a silent fallback to the slow
+    path is visible in /metrics."""
+    tiles = _resolve_tiles(qm, x.shape[0], block_n, block_d)
+    if tiles is None:
+        _note_path("q40_matmul", "xla_fallback")
+        return _q40_matmul_fallback_jit(x, qm)
     if interpret is None:
         # platform may be a plugin name (not literally "tpu"); interpret only
         # on CPU, where mosaic can't compile
         interpret = jax.devices()[0].platform == "cpu"
+    if path is None:
+        path = default_q40_path()
+    bn, bd = tiles
+    if path == "int8":
+        _note_path("q40_matmul", "mxu_int8")
+        return _q40_matmul_int8(x, qm, bn, bd, interpret)
+    _note_path("q40_matmul", "vpu_f32")
+    return _q40_matmul_f32(x, qm, bn, bd, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
+def _q40_matmul_f32(
+    x: jax.Array,
+    qm: QuantizedMatrix,
+    block_n: int,
+    block_d: int,
+    interpret: bool,
+) -> jax.Array:
+    """The f32-dequant kernel path: tiles are pre-resolved (the dispatch in
+    :func:`q40_matmul` owns eligibility); internally the kernel runs on the
+    padded arrays (zero-scale padding → exact-zero contributions) and trims
+    the output."""
+    n, d = qm.n, qm.d
+    np_, dp = qm.n_padded, qm.d_padded
+    T = x.shape[0]
 
     if x.shape[-1] != np_:
         if qm.interleaved:
@@ -531,10 +621,8 @@ def q40_matmul(
         out_specs=pl.BlockSpec((T, block_d), lambda i, j: (0, i)),
         out_shape=jax.ShapeDtypeStruct((T, dp), jnp.float32),
         scratch_shapes=[pltpu.VMEM((T, block_d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
         interpret=interpret,
+        **tpu_compiler_params(dimension_semantics=("parallel", "arbitrary")),
     )(xb, xb, qm.qs, qm.scales, qm.scales)
     # the kernel dequantized BIASED nibbles (0..15); subtract the +8 bias as
     # a rank-reduced correction on the MXU instead of 2 VPU passes over every
@@ -561,6 +649,196 @@ def q40_matmul(
         # true-f32 multiplies: the correction cancels against a 5x-larger
         # kernel sum, so TPU's default bf16 demotion would leak error; the
         # dot is rank-n/32 — 3-pass f32 costs nothing measurable
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out = out - 8.0 * corr
+    return out[:, :d] if dp != d else out
+
+
+# ---------------------------------------------------------------------------
+# int8 MXU path: Q40 weights × Q80 activations (ROADMAP item 1)
+# ---------------------------------------------------------------------------
+#
+# The f32 kernel above is VPU-bound in the nibble unpack: every weight
+# element pays a cast + mask/shift + scale multiply on the 8×128 VPU before
+# the MXU sees it (PERF.md measured ~55% of HBM roofline; the numerically-
+# wrong pltpu.repeat experiment bounded the remaining VPU-broadcast win at
+# ~+9%). The int8 path moves the arithmetic onto the MXU's native int8
+# systolic array instead (reference: matmulQ40vQ80, src/funcs.cpp:287-396 —
+# the reference's production combination for exactly this reason):
+#
+#   * activations quantize to Q80 — per-32-block int8 + f32 scale, the
+#     reference's buffer format — ONE cheap pass over the [T, n] x (tiny
+#     next to the [n, d] weight);
+#   * the kernel contracts BIASED int8 nibbles against int8 activations
+#     with exact int32 accumulation, one 32-deep dot PER QUANT BLOCK: the
+#     pack layout is restructured (reshape, not relayout — the half-split
+#     windows already group whole blocks) so the blocks ride the MXU batch
+#     axis while the 128-multiple output tile fills the 128-wide lane axis
+#     of the contraction;
+#   * the scale product sx[t,b]·sw[b,d] folds in AFTER the integer dot (a
+#     [T, nb, bd]-sized epilogue — 32× less VPU work than scaling every
+#     weight element, and exact: int32 block sums are exact, so the only
+#     new noise is the Q80 activation rounding itself, ~0.4% per element
+#     against Q40's own ~3%);
+#   * the +8 nibble bias stays a rank-reduced MXU correction exactly like
+#     the f32 path, computed from the DEQUANTIZED Q80 block sums (the same
+#     values the kernel consumed, so the cancellation is exact in f32).
+
+
+def quantize_q80(x: jax.Array, qm: QuantizedMatrix) -> tuple[jax.Array, jax.Array]:
+    """Quantize activations [T, n_pad] to Q80 in ``qm``'s OWN basis:
+    (int8 values [T, n_pad], f32 scales [T, n_pad/32]) with scale rows in
+    the weight-scales block order (symmetric, scale = max|x|/127 — the
+    reference's Q80 rule, src/quants.cpp:98-122).
+
+    For an interleaved matrix the block of permuted position ``o`` within a
+    window is ``o % nb`` (ops.q40 layout note) and each permuted block holds
+    exactly one ORIGINAL block's elements, so the per-block amax — and the
+    (w, c)-ordered scale rows — coincide with the weight scales' original
+    block order with no gather anywhere."""
+    T = x.shape[0]
+    np_ = qm.n_padded
+    xf = x.astype(jnp.float32)
+    if qm.interleaved:
+        W = qm.packed_bn // 2
+        nbt = W // QK
+        xb = xf.reshape(T, np_ // W, QK, nbt)  # (window, q, block c)
+        amax = jnp.max(jnp.abs(xb), axis=2)  # [T, n_w, nbt]
+        sx = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(xb / sx[:, :, None, :]), -127, 127).astype(jnp.int8)
+        return q.reshape(T, np_), sx.reshape(T, np_ // QK)
+    xb = xf.reshape(T, np_ // QK, QK)
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    sx = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xb / sx[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(T, np_), sx
+
+
+def _make_q40_int8_kernel(interleaved: bool):
+    """int8 MXU kernel factory: one (d-tile, n-tile) grid step runs one
+    exact int32 block-dot per quant block and folds the scale products into
+    the f32 accumulator.
+
+    Block layout per half-split window (bn2 = block_n/2 packed rows,
+    nbt = bn2/32 blocks): standard packs group 32 CONSECUTIVE rows per
+    block → reshape [bn2, bd] → [nbt, 32, bd]; interleaved packs put block
+    membership at ``row % nbt`` → reshape [bn2, bd] → [32, nbt, bd]. Both
+    are pure reshapes of the resident tile (the layout restructuring is
+    free), and both feed ONE batched ``dot_general`` with the blocks on the
+    batch axis, 32-deep int8 contraction, and the 128-multiple output tile
+    on the lane axis — int32 accumulation is exact, so block order cannot
+    perturb the result."""
+
+    def kernel(xlo_ref, xhi_ref, sxlo_ref, sxhi_ref, qs_ref, slo_ref,
+               shi_ref, out_ref, acc_ref):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        qs = qs_ref[:]
+        # nibbles stay BIASED (0..15, exact in int8); the -8 is the caller's
+        # rank-reduced MXU correction, same as the f32 kernel
+        lo = (qs & 0xF).astype(jnp.int8)
+        hi = (qs >> 4).astype(jnp.int8)
+        bn2, bd = qs.shape
+        nbt = bn2 // QK
+
+        def half(xq_ref, sx_ref, w_nibbles, sw_ref):
+            T = xq_ref.shape[0]
+            if interleaved:
+                # row p belongs to block p % nbt; position o = q*nbt + c
+                xb = xq_ref[:].reshape(T, QK, nbt)
+                wb = w_nibbles.reshape(QK, nbt, bd)
+                contract, batch = ((1,), (0,)), ((2,), (1,))
+            else:
+                xb = xq_ref[:].reshape(T, nbt, QK)
+                wb = w_nibbles.reshape(nbt, QK, bd)
+                contract, batch = ((2,), (1,)), ((1,), (0,))
+            # exact per-block int32 accumulation on the MXU int8 path
+            P = jax.lax.dot_general(
+                xb, wb, (contract, batch), preferred_element_type=jnp.int32,
+            )  # [nbt, T, bd]
+            # scale-product epilogue: sum_b sx[t,b] * sw[b,d] * P[b,t,d] —
+            # [T, nbt, bd]-sized VPU work vs the f32 kernel's per-weight-
+            # element scale multiply
+            scaled = P.astype(jnp.float32) * sw_ref[:][:, None, :]
+            return jnp.sum(scaled * jnp.transpose(sx_ref[:])[:, :, None], axis=0)
+
+        acc_ref[:] += half(xlo_ref, sxlo_ref, lo, slo_ref)
+        acc_ref[:] += half(xhi_ref, sxhi_ref, hi, shi_ref)
+
+        @pl.when(j == pl.num_programs(1) - 1)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
+def _q40_matmul_int8(
+    x: jax.Array,
+    qm: QuantizedMatrix,
+    block_n: int,
+    block_d: int,
+    interpret: bool,
+) -> jax.Array:
+    """The int8 MXU path of :func:`q40_matmul`: Q80-quantize x, run the
+    per-block int8 kernel, subtract the +8 bias as the rank-reduced MXU
+    correction computed from the DEQUANTIZED Q80 sums (exactly the values
+    the kernel consumed, so the f32 cancellation is exact)."""
+    n, d = qm.n, qm.d
+    np_, dp = qm.n_padded, qm.d_padded
+    T = x.shape[0]
+    if x.shape[-1] != np_:
+        if qm.interleaved:
+            # same contract as the f32 kernel: the interleaved basis
+            # intersperses pad features; end-padding cannot fix a mismatch
+            raise ValueError(
+                f"interleaved matmul needs x width {np_}, got {x.shape[-1]}"
+            )
+        x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
+    xq, sx = quantize_q80(x, qm)
+    nj = np_ // block_n
+    grid = (dp // block_d, nj)
+    nbt = block_n // 2 // QK
+    out = pl.pallas_call(
+        _make_q40_int8_kernel(qm.interleaved),
+        grid=grid,
+        in_specs=[
+            # Q80 activations: lo/hi halves as two contiguous BlockSpec
+            # views, exactly like the f32 kernel's x windows
+            pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
+            pl.BlockSpec((T, block_n // 2), lambda i, j, nj=nj: (0, nj + j)),
+            # per-block activation scales, same window split
+            pl.BlockSpec((T, nbt), lambda i, j: (0, j)),
+            pl.BlockSpec((T, nbt), lambda i, j, nj=nj: (0, nj + j)),
+            pl.BlockSpec((block_n // 2, block_d), lambda i, j: (j, i)),
+            pl.BlockSpec((nbt, block_d), lambda i, j: (j, i)),
+            pl.BlockSpec((nbt, block_d), lambda i, j, nj=nj: (nj + j, i)),
+        ],
+        out_specs=pl.BlockSpec((T, block_d), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((T, dp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((T, block_d), jnp.float32)],
+        interpret=interpret,
+        **tpu_compiler_params(dimension_semantics=("parallel", "arbitrary")),
+    )(xq, xq, sx, sx, qm.qs, qm.scales, qm.scales)
+    # bias correction on the DEQUANTIZED Q80 block sums: sum_{i in b} of
+    # sx[t,b]*xq[t,i] — f32-exact given the int sums are exact
+    if qm.interleaved:
+        W = qm.packed_bn // 2
+        nbt_w = W // QK
+        qsum = jnp.sum(
+            xq.astype(jnp.float32).reshape(T, np_ // W, QK, nbt_w), axis=2
+        ).reshape(T, np_ // QK)
+    else:
+        qsum = jnp.sum(xq.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
+    xsum = sx * qsum
+    corr = jax.lax.dot_general(
+        xsum, qm.scales,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
     out = out - 8.0 * corr
@@ -603,6 +881,11 @@ def _largest_divisor_tile(dim: int, target: int, granule: int) -> int | None:
         if dim % b == 0:
             best = b
     return best
+
+
+@jax.jit
+def _q40_matmul_fallback_jit(x: jax.Array, qm: QuantizedMatrix) -> jax.Array:
+    return _q40_matmul_fallback(x, qm)
 
 
 def _q40_matmul_fallback(x: jax.Array, qm: QuantizedMatrix) -> jax.Array:

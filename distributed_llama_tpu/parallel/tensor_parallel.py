@@ -13,9 +13,13 @@ src/commands.cpp:11-108):
                              reference keeps logits root-only instead)
 
 What the reference does with 4 TCP hops per layer (broadcast xb, gather xbv,
-broadcast xb, gather xbv — README.md:135-147) is here exactly 2 psums per
-layer (after wo and after w2) riding ICI, with the activation broadcast
-replaced by replicated-by-construction compute.
+broadcast xb, gather xbv — README.md:135-147) is here exactly 2 all-reduces
+per layer (after wo and after w2) riding ICI, with the activation broadcast
+replaced by replicated-by-construction compute. The all-reduces route
+through the seam in ``ops.collectives``: ``lax.psum`` by default, with
+the bidirectional ``make_async_remote_copy`` ring kernel (the reduce
+overlaps the matmul epilogue instead of serializing after it) behind
+``DLT_ALLREDUCE=ring`` until the chip smoke validates its Mosaic build.
 
 The divisibility constraint mirrors ``nSlices <= nKvHeads``
 (reference: src/transformer.cpp:108-111): tp must divide n_kv_heads (and
@@ -542,9 +546,14 @@ class TensorParallelForward(TransferProbeMixin):
             x, lg = carry
 
             def layer_step(c, _):
-                # two all-reduces per layer, as in the forward program
-                c = jax.lax.psum(c, "tp") * 0.5
-                c = jax.lax.psum(c, "tp") * 0.5
+                # two all-reduces per layer, as in the forward program —
+                # through the SAME seam the forward uses (ops.collectives),
+                # so the probe times whichever implementation (psum / ring)
+                # production decode actually rides
+                from distributed_llama_tpu.ops import collectives
+
+                c = collectives.all_reduce(c, "tp") * 0.5
+                c = collectives.all_reduce(c, "tp") * 0.5
                 return c, None
 
             x, _ = jax.lax.scan(layer_step, x, None, length=cfg.n_layers)

@@ -414,6 +414,26 @@ def note_compile_cache_hit() -> None:
         ).inc()
 
 
+def note_kernel_path(kernel: str, path: str) -> None:
+    """Count one hot-path kernel DISPATCH DECISION by (kernel, path) —
+    ``dllama_kernel_path_total`` (docs/OBSERVABILITY.md). Decisions happen
+    at trace time (once per compiled program build, or once per eager
+    call), not per token, so the rate is tiny and the registry lookup per
+    event is fine (the note_compile_cache_hit pattern, no bind-once
+    needed). The operational read: any ``fallback``/``xla``-labelled
+    series moving on a TPU deployment means a hot-path program silently
+    took the slow path — the Pallas-kernel A/B gate as a live metric."""
+    if _enabled:
+        REGISTRY.counter(
+            "dllama_kernel_path_total",
+            "Kernel dispatch decisions by kernel (q40_matmul / "
+            "paged_attention / all_reduce) and selected path (mxu_int8 / "
+            "vpu_f32 / pallas_fused / xla_segmented / ici_ring / ring_xla / "
+            "psum / xla_fallback); counted at trace time per program build",
+            labelnames=("kernel", "path"),
+        ).labels(kernel=kernel, path=path).inc()
+
+
 class CollectiveInstruments:
     """The parallel backends' transfer-probe surface (TransferProbeMixin)."""
 

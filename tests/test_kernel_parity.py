@@ -1,0 +1,289 @@
+"""Interpret-mode parity gates for the ISSUE 14 Pallas kernels.
+
+Three kernels, three contracts, all runnable on the CPU test substrate
+(conftest pins JAX_PLATFORMS=cpu + an 8-device virtual mesh):
+
+* int8 MXU Q40×Q80 matmul: tolerance vs the f32 kernel and the
+  dequantize-then-matmul reference (the int8 path adds ONLY the Q80
+  activation rounding, ~0.5% — far under Q40's own ~3% noise), standard
+  AND block-interleaved bases, plus path-dispatch/telemetry checks.
+* fused paged decode-attention: BIT-parity vs the segmented-scan chain it
+  replaces, across bf16/f32/i8 and bucket shapes — the same machinery
+  that caught bucket-shape drift in PR 10 gates the kernel.
+* ring all-reduce: the ring schedule (ppermute realization — the
+  container's jax cannot interpret remote DMA; the version gate in
+  ops/collectives.py documents this) vs psum under the CPU mesh mocks,
+  including cross-shard byte-identity of the replicated result.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.ops import attention as att
+from distributed_llama_tpu.ops import kv_cache as kvc
+from distributed_llama_tpu.ops.q40 import (
+    dequantize_tpu,
+    interleave_input_rows,
+    q40_matmul,
+    quantize_q40_tpu,
+    quantize_q80,
+)
+
+
+class TestInt8Matmul:
+    def _qm(self, n=1024, d=256, seed=2):
+        rng = np.random.RandomState(seed)
+        w = rng.randn(n, d).astype(np.float32) / np.sqrt(n)
+        return quantize_q40_tpu(w), rng
+
+    @pytest.mark.parametrize("T", [1, 8])
+    def test_int8_matches_dequant_and_f32_kernel(self, T):
+        qm, rng = self._qm()
+        x = jnp.asarray(rng.randn(T, qm.n).astype(np.float32))
+        want = np.asarray(x @ jnp.asarray(dequantize_tpu(qm)))
+        f32 = np.asarray(q40_matmul(x, qm, interpret=True, path="f32"))
+        i8 = np.asarray(q40_matmul(x, qm, interpret=True, path="int8"))
+        scale = np.abs(want).max()
+        # f32 kernel: bf16-free in interpret mode — near-exact
+        np.testing.assert_allclose(f32 / scale, want / scale, atol=1e-5)
+        # int8 adds only the Q80 activation rounding (~0.5% per element)
+        np.testing.assert_allclose(i8 / scale, want / scale, atol=2e-2)
+        np.testing.assert_allclose(i8 / scale, f32 / scale, atol=2e-2)
+
+    @pytest.mark.parametrize("T", [1, 8])
+    def test_int8_interleaved_matches_standard(self, T):
+        from distributed_llama_tpu.ops.q40 import _q40_matmul_fallback, interleave_perm
+
+        qm, rng = self._qm(n=1024, d=256, seed=5)
+        qi = interleave_input_rows(qm)
+        assert qi.interleaved
+        x = jnp.asarray(rng.randn(T, qm.n_padded).astype(np.float32))
+        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
+        want = np.asarray(_q40_matmul_fallback(x[:, np.argsort(perm)], qm))
+        got = np.asarray(q40_matmul(x, qi, interpret=True, path="int8"))
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got / scale, want[:, : qi.d] / scale, atol=2e-2)
+
+    def test_q80_block_scales_follow_weight_scale_order(self):
+        """The interleaved-basis Q80 quantization must produce the SAME
+        scales as the standard basis (permuted blocks hold exactly one
+        original block's elements), so the kernel's scale rows line up
+        with the weight scales in both layouts."""
+        qm, rng = self._qm(n=1024, d=128, seed=7)
+        qi = interleave_input_rows(qm)
+        from distributed_llama_tpu.ops.q40 import interleave_perm
+
+        x = rng.randn(3, qm.n_padded).astype(np.float32)
+        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
+        xq_s, sx_s = quantize_q80(jnp.asarray(x), qm)
+        xq_i, sx_i = quantize_q80(jnp.asarray(x[:, perm]), qi)
+        np.testing.assert_array_equal(np.asarray(sx_s), np.asarray(sx_i))
+        np.testing.assert_array_equal(
+            np.asarray(xq_s)[:, perm], np.asarray(xq_i)
+        )
+
+    def test_dispatch_fallback_small_shapes(self):
+        """Matrices too small to tile take the XLA fallback on EVERY path
+        (the dispatch owns eligibility, not the path argument)."""
+        rng = np.random.RandomState(3)
+        w = rng.randn(64, 96).astype(np.float32)
+        qm = quantize_q40_tpu(w)
+        x = jnp.asarray(rng.randn(2, 64).astype(np.float32))
+        want = x @ jnp.asarray(dequantize_tpu(qm))
+        for path in ("int8", "f32", None):
+            got = q40_matmul(x, qm, path=path)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+            )
+
+    def test_kernel_path_counter(self):
+        """Every dispatch decision lands in dllama_kernel_path_total — the
+        silent-fallback witness (TEL-001's table row in OBSERVABILITY.md)."""
+        from distributed_llama_tpu import telemetry
+
+        telemetry.enable()
+        try:
+            telemetry.reset()
+            qm, rng = self._qm(n=1024, d=256, seed=9)
+            x = jnp.asarray(rng.randn(1, qm.n).astype(np.float32))
+            q40_matmul(x, qm, interpret=True, path="int8")
+            q40_matmul(x, qm, interpret=True, path="f32")
+            small = quantize_q40_tpu(rng.randn(64, 96).astype(np.float32))
+            q40_matmul(jnp.asarray(rng.randn(1, 64).astype(np.float32)), small)
+            ctr = telemetry.REGISTRY.counter(
+                "dllama_kernel_path_total", labelnames=("kernel", "path")
+            )
+            for path in ("mxu_int8", "vpu_f32", "xla_fallback"):
+                assert ctr.labels(kernel="q40_matmul", path=path).value >= 1, path
+        finally:
+            telemetry.reset()
+            telemetry.disable()
+
+
+def _mk_half(rng, shape, dtype):
+    a = rng.randn(*shape).astype(np.float32)
+    if dtype == "i8":
+        q, s = kvc.quantize_rows(jnp.asarray(a).reshape(-1, *shape[-2:]))
+        return kvc.QuantizedKV(
+            q.reshape(shape), s.reshape(shape[:-1] + (1,))
+        )
+    return jnp.asarray(a).astype(dtype)
+
+
+class TestFusedPagedAttention:
+    """Bit-parity of the fused Pallas hit path vs the segmented scan —
+    the EXACT-EMPTY-PARTIAL merge semantics must survive verbatim."""
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, "i8"])
+    @pytest.mark.parametrize("B,S,chunk,page", [(4, 64, 16, 4), (2, 96, 24, 8)])
+    def test_bit_parity_vs_segmented_scan(self, dtype, B, S, chunk, page):
+        rng = np.random.RandomState(0)
+        K, M, hd, P_ = 2, 2, 8, 16
+        qg = jnp.asarray(rng.randn(B, K, M, hd).astype(np.float32))
+        keys = _mk_half(rng, (B, S, K, hd), dtype)
+        values = _mk_half(rng, (B, S, K, hd), dtype)
+        pool_k = _mk_half(rng, (P_, page, K, hd), dtype)
+        pool_v = _mk_half(rng, (P_, page, K, hd), dtype)
+        tables = jnp.asarray(rng.randint(0, P_, (B, S // page)).astype(np.int32))
+        matched = jnp.asarray(
+            rng.randint(0, S // page + 1, B).astype(np.int32) * page
+        )
+        pos = jnp.asarray(rng.randint(0, S, B).astype(np.int32))
+        paged = (pool_k, pool_v, tables, matched)
+        os.environ["DLT_FUSED_PAGED"] = "0"
+        try:
+            ref = att.batched_decode_attention(qg, keys, values, pos, chunk, paged=paged)
+        finally:
+            os.environ.pop("DLT_FUSED_PAGED", None)
+        got = att.fused_paged_decode_attention(qg, keys, values, pos, chunk, paged)
+        assert bool(jnp.all(got == ref)), float(jnp.max(jnp.abs(got - ref)))
+
+    def test_dispatch_takes_fused_path_and_counts_it(self):
+        from distributed_llama_tpu import telemetry
+
+        telemetry.enable()
+        try:
+            telemetry.reset()
+            rng = np.random.RandomState(1)
+            B, S, K, M, hd, chunk, page, P_ = 2, 32, 2, 1, 8, 8, 4, 8
+            qg = jnp.asarray(rng.randn(B, K, M, hd).astype(np.float32))
+            keys = _mk_half(rng, (B, S, K, hd), jnp.float32)
+            values = _mk_half(rng, (B, S, K, hd), jnp.float32)
+            paged = (
+                _mk_half(rng, (P_, page, K, hd), jnp.float32),
+                _mk_half(rng, (P_, page, K, hd), jnp.float32),
+                jnp.zeros((B, S // page), jnp.int32),
+                jnp.asarray([8, 0], jnp.int32),
+            )
+            pos = jnp.asarray([20, 5], jnp.int32)
+            att.batched_decode_attention(qg, keys, values, pos, chunk, paged=paged)
+            ctr = telemetry.REGISTRY.counter(
+                "dllama_kernel_path_total", labelnames=("kernel", "path")
+            )
+            assert ctr.labels(kernel="paged_attention", path="pallas_fused").value >= 1
+            os.environ["DLT_FUSED_PAGED"] = "0"
+            try:
+                att.batched_decode_attention(qg, keys, values, pos, chunk, paged=paged)
+            finally:
+                os.environ.pop("DLT_FUSED_PAGED", None)
+            assert ctr.labels(kernel="paged_attention", path="xla_segmented").value >= 1
+        finally:
+            telemetry.reset()
+            telemetry.disable()
+
+    def test_non_paged_path_untouched(self):
+        """paged=None must never route to the fused kernel (the plain slab
+        scan is the cold path the parity suites pin separately)."""
+        rng = np.random.RandomState(2)
+        B, S, K, M, hd, chunk = 2, 32, 2, 1, 8, 8
+        qg = jnp.asarray(rng.randn(B, K, M, hd).astype(np.float32))
+        keys = _mk_half(rng, (B, S, K, hd), jnp.float32)
+        values = _mk_half(rng, (B, S, K, hd), jnp.float32)
+        pos = jnp.asarray([20, 5], jnp.int32)
+        out = att.batched_decode_attention(qg, keys, values, pos, chunk)
+        assert out.shape == (B, K, M, hd)
+
+
+class TestRingAllReduce:
+    def _mesh(self):
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        return Mesh(mesh_utils.create_device_mesh((8,)), ("tp",))
+
+    def test_ring_xla_matches_psum(self):
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_llama_tpu.ops import collectives
+
+        mesh = self._mesh()
+        rng = np.random.RandomState(0)
+
+        def weighted(impl):
+            def f(y):
+                w = 1.0 + jax.lax.axis_index("tp").astype(jnp.float32)
+                return collectives.all_reduce(y * w, "tp", impl=impl)
+
+            return jax.jit(collectives.shard_map_compat(
+                f, mesh=mesh, in_specs=P(None, None), out_specs=P(None, None)
+            ))
+
+        for d in (4096, 4100, 256):
+            x = jnp.asarray(rng.randn(2, d).astype(np.float32))
+            ring = np.asarray(weighted("ring_xla")(x))
+            psum = np.asarray(weighted("psum")(x))
+            np.testing.assert_allclose(ring, psum, rtol=1e-5, atol=1e-5)
+
+    def test_ring_replicated_bit_identity(self):
+        """Replicated operands (the TP forward's case: every shard holds
+        the same partial layout) must reduce to byte-identical results on
+        every shard — the property replicated device sampling rests on."""
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_llama_tpu.ops import collectives
+
+        mesh = self._mesh()
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 512).astype(np.float32))
+
+        def f(y):
+            out = collectives.all_reduce(y, "tp", impl="ring_xla")
+            # re-expose per-shard results so divergence would be visible
+            return out[None]
+
+        g = jax.jit(collectives.shard_map_compat(
+            f, mesh=mesh, in_specs=P(None, None), out_specs=P("tp", None, None)
+        ))
+        per_shard = np.asarray(g(x))  # [8, 2, 512]
+        for i in range(1, 8):
+            np.testing.assert_array_equal(per_shard[0], per_shard[i])
+        # and the ring equals psum bitwise on replicated inputs
+        h = jax.jit(collectives.shard_map_compat(
+            lambda y: jax.lax.psum(y, "tp"),
+            mesh=mesh, in_specs=P(None, None), out_specs=P(None, None),
+        ))
+        np.testing.assert_array_equal(per_shard[0], np.asarray(h(x)))
+
+    def test_small_payload_falls_back_to_psum(self):
+        """Payloads narrower than the axis take psum (the ring would ship
+        empty chunks); the seam must stay correct, not just fast."""
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_llama_tpu.ops import collectives
+
+        mesh = self._mesh()
+        x = jnp.ones((1, 4), jnp.float32)
+        g = jax.jit(collectives.shard_map_compat(
+            lambda y: collectives.all_reduce(y, "tp", impl="ring_xla"),
+            mesh=mesh, in_specs=P(None, None), out_specs=P(None, None),
+        ))
+        np.testing.assert_array_equal(np.asarray(g(x)), np.full((1, 4), 8.0))
+
+    def test_seam_default_off_tpu_is_psum(self):
+        from distributed_llama_tpu.ops import collectives
+
+        assert collectives.default_impl() == "psum"  # CPU test substrate

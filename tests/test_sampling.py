@@ -1,5 +1,6 @@
 """On-device sampling + fused decode loop tests (counter-PRNG sampler)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -330,3 +331,78 @@ class TestGenerateChunks:
         # pos hits the limit after ceil((10-3)/4)=2 chunks of 4
         assert len(drawn) == 8
         assert e.pos == 11
+
+
+class TestPartitionToppFallback:
+    """The exact partition-based selection replacing the full-vocab sort
+    for bare top-p over near-flat logits (ISSUE 14 satellite; ROADMAP
+    item 2's named follow-up): picks must match the sort path exactly."""
+
+    def test_partition_matches_full_sort(self):
+        from distributed_llama_tpu.models.sampling import (
+            _pick_sorted,
+            _topp_partition_pick,
+        )
+
+        rng = np.random.RandomState(0)
+        B, V = 8, 3000
+        for trial in range(12):
+            scale = (0.01, 0.1, 1.0)[trial % 3]  # near-flat → peaked
+            logits = jnp.asarray(rng.randn(B, V).astype(np.float32) * scale)
+            probs = jax.nn.softmax(logits, axis=-1)
+            coin = jnp.asarray(rng.rand(B).astype(np.float32))
+            topp = jnp.full(B, (0.9, 0.99, 0.5)[trial % 3], jnp.float32)
+            topk = jnp.zeros(B, jnp.int32)
+            fi = jax.lax.top_k(logits, V)[1]
+            want = np.asarray(_pick_sorted(
+                jnp.take_along_axis(probs, fi, axis=-1), fi, coin, topp, topk
+            ))
+            got = np.asarray(_topp_partition_pick(probs, logits, coin, topp))
+            np.testing.assert_array_equal(got, want, err_msg=f"trial {trial}")
+
+    def test_partition_handles_ties(self):
+        from distributed_llama_tpu.models.sampling import (
+            _pick_sorted,
+            _topp_partition_pick,
+        )
+
+        rng = np.random.RandomState(1)
+        # blocks of exactly-equal logits: canonical order breaks ties by
+        # lower id — the partition path must reproduce that, not just the
+        # kept mass
+        logits = jnp.asarray(np.repeat(rng.randn(4, 40).astype(np.float32), 10, axis=1))
+        probs = jax.nn.softmax(logits, axis=-1)
+        coin = jnp.asarray(rng.rand(4).astype(np.float32))
+        topp = jnp.full(4, 0.7, jnp.float32)
+        fi = jax.lax.top_k(logits, 400)[1]
+        want = np.asarray(_pick_sorted(
+            jnp.take_along_axis(probs, fi, axis=-1), fi, coin, topp,
+            jnp.zeros(4, jnp.int32),
+        ))
+        got = np.asarray(_topp_partition_pick(probs, logits, coin, topp))
+        np.testing.assert_array_equal(got, want)
+
+    def test_fused_pick_routes_bare_topp_overflow_to_partition(self):
+        """End to end through fused_pick: near-flat logits with bare top-p
+        (the overflow regime) at a vocab ABOVE TOPP_PARTITION_MIN_V must
+        produce the same token as the sorted reference pick — the routing
+        change is invisible to outputs."""
+        from distributed_llama_tpu.models.sampling import (
+            TOPP_PARTITION_MIN_V,
+            _pick_sorted,
+            fused_pick,
+        )
+
+        rng = np.random.RandomState(2)
+        B, V = 4, TOPP_PARTITION_MIN_V + 4
+        logits = jnp.asarray(rng.randn(B, V).astype(np.float32) * 0.02)
+        probs = jax.nn.softmax(logits, axis=-1)
+        coin = jnp.asarray(rng.rand(B).astype(np.float32))
+        topp = jnp.full(B, 0.9, jnp.float32)
+        topk = jnp.zeros(B, jnp.int32)
+        fi = jax.lax.top_k(logits, V)[1]
+        want = np.asarray(_pick_sorted(
+            jnp.take_along_axis(probs, fi, axis=-1), fi, coin, topp, topk
+        ))
+        got = np.asarray(fused_pick(probs, logits, coin, topp, topk))
+        np.testing.assert_array_equal(got, want)
