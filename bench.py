@@ -1406,6 +1406,199 @@ def run_prefix_cache(chaos: bool = False) -> dict:
     }
 
 
+def run_pod(data: int = 2, model: int = 2, parallel: int = 4,
+            chunk: int = 32, n_rounds: int = 6) -> dict:
+    """One-process pod vs N-process-style replicas at MATCHED total lanes
+    (`bench.py --pod`, ISSUE 15; numbers -> BENCH_POD_r08.json + PERF.md).
+
+    Baseline arm: ``data`` INDEPENDENT engines, each with its OWN params
+    tree sharded over ``model`` devices, ``parallel`` lanes each — where
+    `--replicas N --tp model` lands this codebase (one weight copy and
+    one dispatch stream per replica). Pod arms, same total lanes on ONE
+    ('data','model') mesh sharing ONE params tree:
+
+    * **consolidated** (headline; serving: ``--pod DxM --replicas 1``) —
+      every lane in ONE batched-decode program per chunk, rows sharded
+      over 'data': the batch-consolidation shape the mesh exists for.
+    * **sliced** (detail; serving default) — one scheduler per data
+      slice (the per-slice failover domain), each dispatching its own
+      chunk program; buys slice-level fault isolation for a per-dispatch
+      tax that CPU mesh mocks overstate (every partition shares the
+      host's cores, so extra program launches serialize; on real chips
+      the slices' programs land on disjoint rows of the mesh).
+
+    Gates: consolidated aggregate tok/s no worse than the baseline;
+    resident weight bytes per replica ~N x lower (per-process tree
+    accounting), CROSS-CHECKED by max_device_weight_bytes_* — a measured
+    per-device walk of every leaf's addressable shards that a broken
+    rule table (silent replication) cannot satisfy by arithmetic."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.formats.synthetic import (
+        tiny_spec,
+        write_synthetic_model,
+    )
+    from distributed_llama_tpu.parallel.pod import (
+        PodGroup,
+        max_device_weight_bytes,
+        tree_weight_bytes,
+    )
+
+    spec = tiny_spec(
+        dim=512, hidden_dim=1536, n_layers=8, n_heads=8, n_kv_heads=8,
+        vocab_size=4096, seq_len=256,
+    )
+    path = write_synthetic_model(
+        os.path.join(tempfile.mkdtemp(prefix="dllama-podbench-"), "m.m"),
+        spec, seed=0,
+    )
+    prefill_len = 32
+    rng = np.random.RandomState(0)
+
+    def make_state(group, lanes: int) -> dict:
+        be = group.backend
+        slab = be.init_batch_cache(lanes, dtype=jnp.float32)
+        firsts = []
+        for i in range(lanes):
+            prompt = jnp.asarray(
+                rng.randint(1, spec.vocab_size, prefill_len, dtype=np.int32)
+            )
+            logits, slab = be.slab_forward(
+                group.params, prompt, slab, i, 0, prefill_len
+            )
+            firsts.append(jnp.argmax(logits[prefill_len - 1]).astype(jnp.int32))
+        return {
+            "g": group, "be": be, "slab": slab, "lanes": lanes,
+            "first": jnp.stack(firsts),
+            "active": jnp.ones(lanes, bool),
+            "temps": jnp.zeros(lanes, jnp.float32),
+            "topps": jnp.full(lanes, 0.9, jnp.float32),
+            "topks": jnp.zeros(lanes, jnp.int32),
+            "seeds": jnp.arange(lanes, dtype=jnp.uint32),
+        }
+
+    def measure_once(states) -> float:
+        """One timed pass: decode ``n_rounds`` chunks per scheduler
+        state, all dispatch streams interleaved on the device queues
+        (dispatch is async, so concurrent schedulers overlap exactly as
+        the pool's do). Aggregate tok/s of the pass."""
+        for st in states:
+            st["pos"] = jnp.full(st["lanes"], prefill_len, jnp.int32)
+            st["nxt"] = st["first"]
+        sw = Stopwatch()
+        for _ in range(n_rounds):
+            for st in states:  # async: chunks interleave on device
+                packed, st["slab"] = st["be"].batched_decode_chunk(
+                    st["g"].params, st["nxt"], st["slab"], st["pos"],
+                    st["active"], chunk, st["temps"], st["topps"],
+                    st["topks"], st["seeds"],
+                )
+                st["nxt"] = packed[chunk - 1]
+                st["pos"] = st["pos"] + chunk
+                st["last"] = packed
+        for st in states:
+            np.asarray(st["last"])  # fence every stream
+        return sum(st["lanes"] for st in states) * n_rounds * chunk / sw.elapsed_s()
+
+    total_lanes = data * parallel
+
+    # all three arms built up front, then measured INTERLEAVED (arm A
+    # rep k, arm B rep k, ...) with per-arm medians: a shared CPU box
+    # drifts over a multi-minute bench, and sequential per-arm timing
+    # would fold that drift into the A/B ratio
+    #
+    # baseline: N independent model-sharded engines (own weights each) on
+    # jax.devices()[:model] — exactly where `--replicas N --tp model`
+    # lands every replica engine in this codebase (InferenceEngine takes
+    # the first tp devices): N weight copies AND N dispatch streams
+    # stacked on one model group, the shape ISSUE 15 replaces
+    lone = [PodGroup.build(path, 1, model, dtype=jnp.float32)
+            for _ in range(data)]
+    base_states = [make_state(g, parallel) for g in lone]
+    base_bytes = sum(tree_weight_bytes(g.params) for g in lone) // len(lone)
+    # MEASURED device residency (addressable shards, not attribution):
+    # the pool's N trees stack on the shared model group's devices
+    base_dev_bytes = max_device_weight_bytes([g.params for g in lone])
+    # pod, consolidated: ONE program for all lanes (--pod DxM --replicas 1)
+    group_c = PodGroup.build(path, data, model, dtype=jnp.float32)
+    cons_states = [make_state(group_c, total_lanes)]
+    pod_bytes = group_c.resident_weight_bytes_per_replica()
+    pod_total_bytes = group_c.weight_bytes
+    pod_dev_bytes = max_device_weight_bytes([group_c.params])
+    # pod, sliced (the per-slice failover serving default): one scheduler
+    # per data slice — a fresh group because the slab layout pins at
+    # first use (every slice shares the backend's compiled programs)
+    group_s = PodGroup.build(path, data, model, dtype=jnp.float32)
+    sliced_states = [make_state(group_s, parallel) for _ in range(data)]
+
+    arms = {"base": base_states, "cons": cons_states, "sliced": sliced_states}
+    for states in arms.values():
+        measure_once(states)  # warm/compile pass, untimed
+    runs: dict = {k: [] for k in arms}
+    for rep in range(5):
+        for name, states in arms.items():
+            with telemetry.trace_span("bench_pod_arm", rep=rep, arm=name):
+                runs[name].append(measure_once(states))
+    base_tps = median(runs["base"])
+    pod_tps = median(runs["cons"])
+    sliced_tps = median(runs["sliced"])
+
+    ratio = pod_tps / base_tps if base_tps else 0.0
+    mem_ratio = base_bytes / pod_bytes if pod_bytes else 0.0
+    return {
+        "metric": f"pod_{data}x{model}_aggregate_tokens_per_sec",
+        "value": round(bench_metric("pod_aggregate_tps", pod_tps, "tokens/sec"), 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(bench_metric("pod_vs_replicas_tps", ratio), 3),
+        "detail": {
+            "replicas_aggregate_tokens_per_sec": round(
+                bench_metric("pod_replicas_tps", base_tps, "tokens/sec"), 2),
+            "pod_sliced_aggregate_tokens_per_sec": round(
+                bench_metric("pod_sliced_tps", sliced_tps, "tokens/sec"), 2),
+            "pod_sliced_vs_replicas": round(
+                sliced_tps / base_tps if base_tps else 0.0, 3),
+            "resident_weight_bytes_per_replica_pod": int(bench_metric(
+                "pod_resident_weight_bytes_per_replica", pod_bytes, "bytes")),
+            "resident_weight_bytes_per_replica_replicas": int(bench_metric(
+                "replicas_resident_weight_bytes_per_replica", base_bytes, "bytes")),
+            "pod_weight_bytes_total": int(pod_total_bytes),
+            "weight_memory_reduction_x": round(
+                bench_metric("pod_weight_memory_reduction", mem_ratio), 2),
+            # MEASURED device residency (max over devices, summed from
+            # every leaf's addressable shards): the gate a broken rule
+            # table cannot satisfy by attribution arithmetic
+            "max_device_weight_bytes_pod": int(bench_metric(
+                "pod_max_device_weight_bytes", pod_dev_bytes, "bytes")),
+            "max_device_weight_bytes_replicas": int(bench_metric(
+                "replicas_max_device_weight_bytes", base_dev_bytes, "bytes")),
+            "max_device_weight_reduction_x": round(
+                bench_metric(
+                    "pod_max_device_weight_reduction",
+                    base_dev_bytes / pod_dev_bytes if pod_dev_bytes else 0.0,
+                ), 2),
+            "data": data, "model": model, "total_lanes": total_lanes,
+            "chunk": chunk,
+            "baseline": f"{data} independent engines (one full weight tree "
+            f"each, sharded over model={model}, {parallel} lanes each) "
+            "driven concurrently on the devices the in-repo replica pool "
+            "uses — the N-process ReplicaPool shape at the same total "
+            "lane count",
+            "note": "value/vs_baseline = the consolidated pod (all lanes "
+            "in one batched program, rows data-sharded; serving: --pod "
+            "DxM --replicas 1). pod_sliced_* = the per-slice failover "
+            "default (one scheduler per data slice); its per-dispatch tax "
+            "is overstated on CPU mesh mocks, where every partition "
+            "timeshares the host cores",
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+
 def run_kernels() -> dict:
     """``bench.py --kernels``: the ISSUE 14 Pallas-kernel A/B gate as one
     committed JSON — each kernel measured against the path it replaces IN
@@ -1685,6 +1878,13 @@ def main_single(weights: str):
 
 
 if __name__ == "__main__":
+    if "--pod" in sys.argv and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        # the pod A/B needs a ('data','model') host mesh; 8 virtual devices
+        # covers the default 2x2 with room (same conftest shape)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
     if "--kernels" in sys.argv and "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
         # the ring-vs-psum parity gate needs a mesh; give the host platform
         # the same 8 virtual devices the test conftest uses (no effect on a
@@ -1698,7 +1898,12 @@ if __name__ == "__main__":
     # deserialization, not a full XLA compile
     from distributed_llama_tpu.platform import enable_compilation_cache
 
-    enable_compilation_cache()
+    if "--pod" not in sys.argv:
+        # the pod arms skip the persistent cache: deserializing their
+        # multi-partition CPU executables corrupts the heap on container
+        # jax 0.4.x (observed: `corrupted double-linked list` on the
+        # second --pod run); a cold compile per run is cheap at bench size
+        enable_compilation_cache()
     # the bench IS an observability consumer: its numbers flow through the
     # telemetry registry (bench_metric) and its phases record trace spans
     telemetry.enable()
@@ -1737,6 +1942,11 @@ if __name__ == "__main__":
         idx = sys.argv.index("--chaos")
         b = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 4
         main_chaos(b)
+    elif "--pod" in sys.argv:
+        # one-process pod vs N-process-style replicas at matched lanes
+        # (ISSUE 15): aggregate tok/s + resident weight bytes per replica
+        # — committed as BENCH_POD_*.json
+        print(json.dumps(run_pod()))
     elif "--kernels" in sys.argv:
         # Pallas kernel A/B gates (ISSUE 14): int8-MXU vs f32 q40 kernel,
         # fused paged attention vs the segmented scan (bit-parity

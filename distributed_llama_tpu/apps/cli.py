@@ -55,6 +55,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel shards (chips)")
     p.add_argument(
+        "--pod", type=str, default=None, metavar="DATAxMODEL",
+        help="one-process pod serving on a single ('data','model') mesh "
+        "(e.g. 2x2): tensor parallelism over 'model' inside every slice, "
+        "data-parallel replicas as slices of the SAME mesh sharing ONE "
+        "weights tree (no N-replica weight copies; ROADMAP item 3). The "
+        "server runs one supervised replica per data slice — a mesh-slice "
+        "failure IS a replica loss with the PR 9/10 failover/replay/"
+        "restart contract, and a slice rebuild never reloads weights. "
+        "Mutually exclusive with --tp/--sp/--ep; testable under "
+        "JAX_PLATFORMS=cpu with --xla_force_host_platform_device_count",
+    )
+    p.add_argument(
         "--sp", type=int, default=1,
         help="sequence-parallel shards: KV cache sharded over the sequence, "
         "ring-attention prefill (long-context mode; composes with --tp on a "
@@ -157,16 +169,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_engine(args):
+def _parse_dtypes(args):
     import jax.numpy as jnp
 
-    from distributed_llama_tpu.engine import InferenceEngine
     from distributed_llama_tpu.engine.weights import QUANTIZED_DTYPE
 
     if getattr(args, "kv_cache_storage", None) not in (None, "ram"):
         # the reference spills the KV cache to disc-backed mmap buffers
         # (reference: src/utils.cpp:50-67); on TPU the cache lives in HBM
-        # inside a jitted program and cannot be file-backed
+        # inside a jitted program and cannot be file-backed — rejected
+        # here so BOTH engine paths (classic and --pod) refuse it
         raise SystemExit(
             f"--kv-cache-storage {args.kv_cache_storage} is not supported on "
             "TPU (the KV cache is device HBM); use --cache-dtype i8 for 2x "
@@ -176,6 +188,64 @@ def make_engine(args):
     cache_dtype = {
         "auto": None, "bf16": jnp.bfloat16, "f32": jnp.float32, "i8": "i8",
     }[getattr(args, "cache_dtype", "auto")]
+    return dtype, cache_dtype
+
+
+def _make_sampler(args, vocab_size: int) -> Sampler:
+    # wall-clock as entropy for a default sampling seed, never a duration
+    seed = args.seed if args.seed is not None else int(time.time())  # dllama: noqa[CLK-001]
+    # counter mode: the host sampler draws the SAME coins the fused device
+    # sampler draws (stateless, keyed on (seed, position)), so a --decode
+    # host run replays a --decode device stream token for token — the
+    # xorshift-parity verification mode (ISSUE 13)
+    return Sampler(
+        vocab_size=vocab_size,
+        temperature=args.temperature,
+        topp=args.topp,
+        topk=args.topk,
+        seed=seed,
+        counter=True,
+    )
+
+
+def make_pod_group(args):
+    """Build the one-process pod substrate from the serving flags: ONE
+    model load placed on the single ('data','model') mesh, plus the
+    tokenizer/sampler pair ``make_engine`` would return. The returned
+    group IS the serving layer's engine factory (slice engines share the
+    pod's weights and compiled programs; a replica rebuild never reloads
+    the file)."""
+    from distributed_llama_tpu.parallel.pod import PodGroup, parse_pod
+
+    if getattr(args, "tp", 1) > 1 or getattr(args, "sp", 1) > 1 or getattr(args, "ep", 1) > 1:
+        raise SystemExit(
+            "--pod owns the whole mesh layout; it does not compose with "
+            "--tp/--sp/--ep (the pod's 'model' axis IS the tensor-parallel "
+            "degree)"
+        )
+    data, model = parse_pod(args.pod)
+    dtype, cache_dtype = _parse_dtypes(args)
+    group = PodGroup.build(
+        args.model, data, model,
+        dtype=dtype,
+        max_seq_len=args.max_seq_len,
+        cache_dtype=cache_dtype,
+        moe_capacity_factor=getattr(args, "moe_capacity", 0.0) or 0.0,
+    )
+    tokenizer = Tokenizer.from_file(args.tokenizer, group.cfg.vocab_size)
+    return group, tokenizer, _make_sampler(args, group.cfg.vocab_size)
+
+
+def make_engine(args):
+    from distributed_llama_tpu.engine import InferenceEngine
+
+    if getattr(args, "pod", None):
+        # one-off pod engine (generate/chat/inference modes): one slice of
+        # a freshly built pod group — the long-lived group path is
+        # serve()'s (the factory must outlive the engine for rebuilds)
+        group, tokenizer, sampler = make_pod_group(args)
+        return group.slice_engine(), tokenizer, sampler
+    dtype, cache_dtype = _parse_dtypes(args)
     engine = InferenceEngine(
         args.model, dtype=dtype, max_seq_len=args.max_seq_len, tp=args.tp,
         sp=getattr(args, "sp", 1), ep=getattr(args, "ep", 1),
@@ -183,21 +253,7 @@ def make_engine(args):
         moe_capacity_factor=getattr(args, "moe_capacity", 0.0) or 0.0,
     )
     tokenizer = Tokenizer.from_file(args.tokenizer, engine.cfg.vocab_size)
-    # wall-clock as entropy for a default sampling seed, never a duration
-    seed = args.seed if args.seed is not None else int(time.time())  # dllama: noqa[CLK-001]
-    # counter mode: the host sampler draws the SAME coins the fused device
-    # sampler draws (stateless, keyed on (seed, position)), so a --decode
-    # host run replays a --decode device stream token for token — the
-    # xorshift-parity verification mode (ISSUE 13)
-    sampler = Sampler(
-        vocab_size=engine.cfg.vocab_size,
-        temperature=args.temperature,
-        topp=args.topp,
-        topk=args.topk,
-        seed=seed,
-        counter=True,
-    )
-    return engine, tokenizer, sampler
+    return engine, tokenizer, _make_sampler(args, engine.cfg.vocab_size)
 
 
 def _print(s: str) -> None:

@@ -739,6 +739,14 @@ class BatchScheduler:
             )
         else:
             self._slab = tp_engine.init_batch_cache(n_rows, dtype=engine.cache_dtype)
+        # backends whose slab shards its BATCH axis across the mesh (the
+        # pod's 'data' axis) dispatch the whole slab every chunk: a sub-
+        # bucket's rows would straddle the wrong shards. The floor is set
+        # by init_batch_cache above; 1 everywhere else (classic bucketing)
+        self._bucket_floor = (
+            min(n_rows, max(1, int(getattr(tp_engine, "decode_bucket_floor", 1))))
+            if tp_engine is not None else 1
+        )
         self._streams: list[BatchStream] = []
         self._cond = threading.Condition()
         # one dispatched-but-unfetched chunk at a time: (tokens_dev, epoch
@@ -1642,7 +1650,9 @@ class BatchScheduler:
         if not joined:
             self._cond.notify_all()
             return
-        bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
+        bucket = decode_bucket(
+            max(max(s.row for s in joined) + 1, self._bucket_floor), self.b_max
+        )
         rows = self._streams[:bucket]
         live, pos, active, temps, topps, topks, seeds, tables, matched = (
             self._row_dispatch_arrays_locked(rows)
@@ -1739,7 +1749,9 @@ class BatchScheduler:
         if not joined:
             self._cond.notify_all()
             return
-        bucket = decode_bucket(max(s.row for s in joined) + 1, self.b_max)
+        bucket = decode_bucket(
+            max(max(s.row for s in joined) + 1, self._bucket_floor), self.b_max
+        )
         rows = self._streams[:bucket]
         T = self.spec_draft + 1
         S = engine.cfg.seq_len

@@ -905,6 +905,39 @@ class InferenceEngine:
         else:
             self.params = jax.device_put(host_params)
             self._forward = functools.partial(self._forward_single, self.cfg)
+        self._init_runtime()
+
+    @classmethod
+    def from_shared(
+        cls, cfg, backend, params, cache_dtype=jnp.bfloat16, spec=None
+    ) -> "InferenceEngine":
+        """An engine over a PRE-BUILT backend and an ALREADY-PLACED params
+        tree — the one-process pod's slice engines (parallel/pod.py): N
+        replicas' engines share one backend (compiled programs built once
+        for the pod) and one params tree (weights resident once per model
+        group), while everything per-slice — KV caches, slab, scheduler,
+        streams, stats — stays per engine, preserving the replica failure
+        domain. A slice REBUILD after failover goes through here too:
+        scheduler + lanes are rebuilt, weights are never reloaded (and the
+        PR 10 rebuild checksum gate passes against the same bytes)."""
+        self = cls.__new__(cls)
+        self.tp = getattr(backend, "tp", 1)
+        self.sp = 1
+        self.ep = 1
+        self._tel = telemetry.EngineInstruments()
+        self._faults = faults.active_plan()
+        self.spec = spec
+        self.cfg = cfg
+        self.cache_dtype = cache_dtype
+        self._tp_engine = backend
+        self.params = params
+        self._forward = backend.forward
+        self._init_runtime()
+        return self
+
+    def _init_runtime(self) -> None:
+        """Per-engine mutable state, shared by the loading constructor and
+        :meth:`from_shared`."""
         # whether the forward accepts the real-token count of a bucket-padded
         # prompt (the capacity-bucketed MoE prefill's pad mask): the
         # single-chip path always does; backends opt in via the attribute
@@ -935,6 +968,15 @@ class InferenceEngine:
         # >0 would freeze the transfer estimate, a negative one would let
         # probes run mid-flight)
         self._depth_lock = threading.Lock()
+        # mesh-topology gauges (ISSUE 15): axis -> device count of the
+        # backend's named mesh, so an operator can read the serving shape
+        # off /metrics (the pod group additionally reports weight bytes)
+        mesh = getattr(self._tp_engine, "mesh", None)
+        if mesh is not None:
+            tel = telemetry.MeshInstruments()
+            if tel.enabled:
+                for axis_name, size in dict(mesh.shape).items():
+                    tel.mesh_devices.labels(axis=axis_name).set(size)
 
     def weights_checksum(self) -> str:
         """The loaded weights' integrity checksum (cached after the first

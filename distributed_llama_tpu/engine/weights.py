@@ -61,11 +61,40 @@ def load_params(
     spec = reader.spec
     cfg = cfg or config_from_spec(spec)
     quantized = dtype == QUANTIZED_DTYPE
+    shard_vocab = tp > 1 and cfg.vocab_size % tp == 0
+    rule_table = None
     if tp > 1:
         from distributed_llama_tpu.parallel.tensor_parallel import validate_tp
 
         validate_tp(cfg, tp, quantized=quantized)
+        from distributed_llama_tpu.parallel import sharding as sharding_rules
+
+        # the ONE sharding authority (ISSUE 15): the rule table decides
+        # every leaf's layout; the load-time shard DIRECTION (row-range
+        # "out" reads vs column-range "in" reads) is DERIVED from the
+        # resolved spec below, never hand-rolled here
+        rule_table = sharding_rules.param_rules(
+            cfg, "q40" if quantized else "layered", shard_vocab
+        )
     np_dtype = np.dtype(jnp.bfloat16 if quantized else dtype)
+
+    def leaf_spec(path: str):
+        return rule_table.spec(path, {"model": "tp"})
+
+    def shard_direction(spec_) -> str:
+        # every matmul layout here stores the output dim LAST (q40 packs
+        # [n/2, d_out], plain [d_in, d_out], expert stacks [E, d_in,
+        # d_out]), so the model axis landing on the last dim means
+        # output-sharded (RowMatmulSlice); anywhere else, input-sharded
+        # (ColMatmulSlice). An unsharded matmul leaf would be a rule-table
+        # bug — surface it as the typed error class
+        if "tp" not in spec_:
+            from distributed_llama_tpu.parallel import sharding as sharding_rules
+
+            raise sharding_rules.ShardingRuleError(
+                f"matmul leaf resolved to replicated spec {spec_} under tp={tp}"
+            )
+        return "out" if spec_[-1] == "tp" else "in"
 
     def cast(x: np.ndarray) -> np.ndarray:
         return x.astype(np_dtype)
@@ -134,7 +163,10 @@ def load_params(
         w = _t(reader.tensor(name), np.float32)[lo:hi]
         return quantize_q40_tpu(np.ascontiguousarray(w))
 
-    def sharded(builder, *args):
+    def sharded(path: str, names):
+        """Sharded q40 leaf for destination ``path``: the rule table's
+        resolved spec picks the slicing direction (out = fused row-range
+        reads, in = quant-block column ranges) and the placement layout."""
         from distributed_llama_tpu.ops.q40 import (
             QuantizedMatrix,
             _d_padded,
@@ -142,7 +174,13 @@ def load_params(
             concat_shard_packs,
         )
 
-        axis = "out" if builder is shard_out else "in"
+        spec = leaf_spec(path)
+        axis = shard_direction(spec)
+        if axis == "out":
+            names_l = names if isinstance(names, list) else [names]
+            builder, args = shard_out, (names_l,)
+        else:
+            builder, args = shard_in, (names,)
         if mesh is None:
             return concat_shard_packs([builder(*args, s) for s in range(tp)], axis)
 
@@ -162,7 +200,6 @@ def load_params(
         qs_shard = (np_ // 2, dp)
         sc_shard = (np_ // 32, dp)
         ax = 1 if axis == "out" else 0
-        spec = shd.PartitionSpec(None, "tp") if axis == "out" else shd.PartitionSpec("tp", None)
         qs_gshape = tuple(
             s * tp if i == ax else s for i, s in enumerate(qs_shard)
         )
@@ -238,35 +275,30 @@ def load_params(
         built.clear()
         return arr
 
-    def sharded_plain(name: str, axis: str):
+    def sharded_plain(path: str, name: str):
         """Per-shard lazy read of a bf16/f32 matmul weight: the non-quantized
         analogue of ``sharded()`` (reader.tensor_rows / tensor_cols range
         reads) — O(model/tp) file traffic per host for every dtype, not just
         q40 (replacing the reference's root-reads-everything scatter for
-        bf16 as well, src/transformer.cpp:432-451)."""
-        import jax.sharding as shd
-
+        bf16 as well, src/transformer.cpp:432-451). Direction and spec come
+        from the rule table, keyed by the destination leaf path."""
+        spec = leaf_spec(path)
+        axis = shard_direction(spec)
         d_out, d_in = reader.entries[name].shape
         ax = 1 if axis == "out" else 0
-        spec = shd.PartitionSpec(None, "tp") if axis == "out" else shd.PartitionSpec("tp", None)
         return _place_shards(
             (d_in, d_out), ax, spec,
             lambda s: np.ascontiguousarray(_read_shard(name, axis, s)).astype(np_dtype),
         )
 
-    def sharded_plain_expert_stack(expert_names: list[str], axis: str):
+    def sharded_plain_expert_stack(path: str, expert_names: list[str]):
         """Sharded read of a stacked MoE expert bank: [E, d_in, d_out] with
         the matmul dim sharded (moe_up/gate: out; moe_down: in). Each shard
         stacks its per-expert row/column-range reads."""
-        import jax.sharding as shd
-
+        spec = leaf_spec(path)
+        axis = shard_direction(spec)
         d_out, d_in = reader.entries[expert_names[0]].shape
         ax = 2 if axis == "out" else 1
-        spec = (
-            shd.PartitionSpec(None, None, "tp")
-            if axis == "out"
-            else shd.PartitionSpec(None, "tp", None)
-        )
         return _place_shards(
             (len(expert_names), d_in, d_out), ax, spec,
             lambda s: np.ascontiguousarray(
@@ -281,17 +313,18 @@ def load_params(
 
     for l in range(cfg.n_layers):
         p = f"layers.{l}."
+        lpath = f"layers/{l}"
         if quantized and tp > 1:
-            add("qkv", sharded(shard_out, [p + "q", p + "k", p + "v"]))
-            add("wo", sharded(shard_in, p + "wo"))
+            add("qkv", sharded(f"{lpath}/qkv", [p + "q", p + "k", p + "v"]))
+            add("wo", sharded(f"{lpath}/wo", p + "wo"))
         elif quantized:
             add("qkv", weight_fused([p + "q", p + "k", p + "v"]))
             add("wo", weight(p + "wo"))
         elif tp > 1:
-            add("q", sharded_plain(p + "q", "out"))
-            add("k", sharded_plain(p + "k", "out"))
-            add("v", sharded_plain(p + "v", "out"))
-            add("wo", sharded_plain(p + "wo", "in"))
+            add("q", sharded_plain(f"{lpath}/q", p + "q"))
+            add("k", sharded_plain(f"{lpath}/k", p + "k"))
+            add("v", sharded_plain(f"{lpath}/v", p + "v"))
+            add("wo", sharded_plain(f"{lpath}/wo", p + "wo"))
         else:
             add("q", weight(p + "q"))
             add("k", weight(p + "k"))
@@ -310,8 +343,10 @@ def load_params(
                 ep = f"{p}experts.{e}."
                 if tp > 1:
                     experts.append({
-                        "gate_up": sharded(shard_out, [ep + "gate", ep + "up"]),
-                        "down": sharded(shard_in, ep + "down"),
+                        "gate_up": sharded(
+                            f"{lpath}/experts/{e}/gate_up", [ep + "gate", ep + "up"]
+                        ),
+                        "down": sharded(f"{lpath}/experts/{e}/down", ep + "down"),
                     })
                 else:
                     experts.append({
@@ -322,9 +357,12 @@ def load_params(
         elif cfg.is_moe and tp > 1:
             add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
             enames = [f"{p}experts.{e}." for e in range(cfg.n_experts)]
-            add("moe_up", sharded_plain_expert_stack([n + "up" for n in enames], "out"))
-            add("moe_gate", sharded_plain_expert_stack([n + "gate" for n in enames], "out"))
-            add("moe_down", sharded_plain_expert_stack([n + "down" for n in enames], "in"))
+            add("moe_up", sharded_plain_expert_stack(
+                f"{lpath}/moe_up", [n + "up" for n in enames]))
+            add("moe_gate", sharded_plain_expert_stack(
+                f"{lpath}/moe_gate", [n + "gate" for n in enames]))
+            add("moe_down", sharded_plain_expert_stack(
+                f"{lpath}/moe_down", [n + "down" for n in enames]))
         elif cfg.is_moe:
             add("router", cast(_t(reader.tensor(p + "moe_router"), np.float32)))
             ups, gates, downs = [], [], []
@@ -337,15 +375,15 @@ def load_params(
             add("moe_gate", cast(np.stack(gates)))
             add("moe_down", cast(np.stack(downs)))
         elif quantized and tp > 1:
-            add("gate_up", sharded(shard_out, [p + "gate", p + "up"]))
-            add("down", sharded(shard_in, p + "down"))
+            add("gate_up", sharded(f"{lpath}/gate_up", [p + "gate", p + "up"]))
+            add("down", sharded(f"{lpath}/down", p + "down"))
         elif quantized:
             add("gate_up", weight_fused([p + "gate", p + "up"]))
             add("down", weight(p + "down"))
         elif tp > 1:
-            add("gate", sharded_plain(p + "gate", "out"))
-            add("down", sharded_plain(p + "down", "in"))
-            add("up", sharded_plain(p + "up", "out"))
+            add("gate", sharded_plain(f"{lpath}/gate", p + "gate"))
+            add("down", sharded_plain(f"{lpath}/down", p + "down"))
+            add("up", sharded_plain(f"{lpath}/up", p + "up"))
         else:
             add("gate", weight(p + "gate"))
             add("down", weight(p + "down"))
@@ -363,10 +401,10 @@ def load_params(
     layers_out: Any = [
         {k: vs[l] for k, vs in layers.items()} for l in range(cfg.n_layers)
     ]
-    if quantized and tp > 1 and cfg.vocab_size % tp == 0:
-        wcls = sharded(shard_out, ["wcls"])  # vocab-sharded logits head
-    elif tp > 1 and cfg.vocab_size % tp == 0:
-        wcls = sharded_plain("wcls", "out")
+    if quantized and shard_vocab:
+        wcls = sharded("wcls", ["wcls"])  # vocab-sharded logits head
+    elif shard_vocab:
+        wcls = sharded_plain("wcls", "wcls")
     else:
         wcls = weight("wcls")
     return {

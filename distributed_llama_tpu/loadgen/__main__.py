@@ -94,10 +94,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--parallel", type=int, default=4,
                    help="self-host serving slots (batch rows) per replica")
     p.add_argument(
-        "--replicas", type=int, default=1,
-        help="self-host supervised data-parallel replicas (ISSUE 9): a "
+        "--replicas", type=int, default=None,
+        help="self-host supervised data-parallel replicas (ISSUE 9; "
+        "default 1, or one per data slice under --pod, where an explicit "
+        "--replicas 1 picks the consolidated single-domain pod): a "
         "replica-kill chaos run composes this with --faults "
         "'replica.crash:...' and gates on --expect-delta/--goodput-floor",
+    )
+    p.add_argument(
+        "--pod", type=str, default=None, metavar="DATAxMODEL",
+        help="self-host ONE-PROCESS pod serving (ISSUE 15): the replica "
+        "set runs as slices of a single ('data','model') mesh sharing "
+        "one weights tree (replicas = the data extent). Needs "
+        "data*model CPU devices (--xla_force_host_platform_device_count "
+        "in XLA_FLAGS); a mid-window 'replica.crash' fault IS the "
+        "mesh-slice kill of the CI pod smoke",
     )
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument(
@@ -222,6 +233,7 @@ def main(argv=None) -> int:
             host_spill_mb=args.host_spill_mb,
             admission_queue=args.admission_queue,
             replicas=args.replicas,
+            pod=args.pod,
             canary_interval_s=args.canary_interval_s,
             shadow_rate=args.shadow_rate,
             topk=args.topk,

@@ -62,7 +62,8 @@ def start_selfhost(
     admission_queue: int | None = None,
     deadline_ms: float | None = None,
     seed: int = 0,
-    replicas: int = 1,
+    replicas: int | None = None,
+    pod: str | None = None,
     canary_interval_s: float = 0.0,
     shadow_rate: float = 0.0,
     topk: int = 0,
@@ -95,7 +96,31 @@ def start_selfhost(
         os.path.join(tempfile.mkdtemp(prefix="dllama-loadgen-"), "m.m"),
         spec, seed=seed,
     )
-    engine = InferenceEngine(path, dtype=jnp.float32)
+    group = None
+    if pod:
+        # one-process pod target (ISSUE 15): the whole replica set runs as
+        # slices of ONE ('data','model') mesh sharing one weights tree —
+        # the CI pod smoke drives the real serving stack through this
+        # under --xla_force_host_platform_device_count CPU mesh mocks
+        from distributed_llama_tpu.parallel.pod import PodGroup, parse_pod
+
+        data, model = parse_pod(pod)
+        group = PodGroup.build(path, data, model, dtype=jnp.float32)
+        engine = group.slice_engine()
+        # an EXPLICIT replicas=1 keeps the CONSOLIDATED single-domain pod
+        # (all lanes in one batched program); the default is one replica
+        # per data slice (slice-level failover) — same contract and same
+        # warning as server/api.py's serve()
+        if replicas not in (None, 1, data):
+            print(
+                f"⚠️ --replicas {replicas} ignored under --pod: one "
+                f"replica per data slice ({data}), or 1 for the "
+                "consolidated single-domain pod"
+            )
+        replicas = 1 if replicas == 1 else data
+    else:
+        replicas = 1 if replicas is None else replicas
+        engine = InferenceEngine(path, dtype=jnp.float32)
     # counter mode (ISSUE 13): production shape — any host-sampled token is
     # a counted fallback, and a host replay matches the device stream
     sampler = Sampler(
@@ -128,10 +153,14 @@ def start_selfhost(
         sdc_shadow_rate=shadow_rate,
     )
     # each replica loads the same weights (compiled programs are shared
-    # across engines — same shapes, same static config)
+    # across engines — same shapes, same static config); under --pod the
+    # group IS the factory and replicas share ONE weights tree
     state = ApiState(
         engine, tok, sampler, args,
-        engine_factory=lambda: InferenceEngine(path, dtype=jnp.float32),
+        engine_factory=(
+            group if group is not None
+            else lambda: InferenceEngine(path, dtype=jnp.float32)
+        ),
     )
     server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
     server.daemon_threads = True

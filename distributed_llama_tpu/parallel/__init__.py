@@ -9,3 +9,7 @@ axes (see distributed_llama_tpu.parallel.context for sequence parallelism).
 from distributed_llama_tpu.parallel.tensor_parallel import TensorParallelForward
 
 __all__ = ["TensorParallelForward"]
+# parallel.sharding (the declarative rule tables) and parallel.pod (the
+# one-process ('data','model') pod) are imported directly by their
+# consumers — no eager import here: sharding is pure-python cheap, but
+# pod pulls mesh construction into import time for every CLI entry

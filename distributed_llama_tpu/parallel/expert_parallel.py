@@ -242,41 +242,18 @@ class ExpertParallelMoE:
 def ep_param_specs(cfg: LlamaConfig, quantized: bool, shard_vocab: bool):
     """PartitionSpecs of the EP params layout on the ("tp", "ep") mesh:
     attention/dense weights follow the TP layout (replicated over ep),
-    expert banks shard experts over ep AND hidden over tp."""
-    from jax.sharding import PartitionSpec as P
+    expert banks shard experts over ep AND hidden over tp. A rule-table
+    lookup (parallel/sharding.py — one spec is a pytree prefix over a
+    stacked QuantizedMatrix: qs [E, n2, d] + scales [E, ns, d] shard
+    alike)."""
+    from distributed_llama_tpu.parallel import sharding
 
-    from distributed_llama_tpu.parallel.tensor_parallel import (
-        layer_param_specs,
-        q40_layer_specs,
+    return sharding.param_specs(
+        cfg,
+        "ep_q40" if quantized else "ep",
+        shard_vocab,
+        {"model": "tp", "expert": "ep"},
     )
-
-    def layer():
-        if quantized:
-            specs = q40_layer_specs(cfg)
-            del specs["experts"]
-            specs.update(
-                # one spec is a pytree prefix over the stacked QuantizedMatrix
-                # (qs [E, n2, d] + scales [E, ns, d] shard alike)
-                experts_gate_up=P("ep", None, "tp"),  # output(hidden)-dim over tp
-                experts_down=P("ep", "tp", None),  # input(hidden)-dim over tp
-            )
-        else:
-            specs = {k: P(*s[1:]) for k, s in layer_param_specs(cfg).items()}
-            specs.update(
-                router=P(None, None),
-                moe_gate=P("ep", None, "tp"),
-                moe_up=P("ep", None, "tp"),
-                moe_down=P("ep", "tp", None),
-            )
-        return specs
-
-    return {
-        "embedding": P(None, None),
-        "layers": [layer() for _ in range(cfg.n_layers)],
-        "rms_final": P(None),
-        "wcls": P(None, "tp") if shard_vocab else P(None, None),
-        "rope_table": P(None, None, None),
-    }
 
 
 def stack_expert_leaves(host_params) -> Any:

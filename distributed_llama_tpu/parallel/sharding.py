@@ -1,0 +1,389 @@
+"""Declarative mesh-sharding rule tables: the ONE sharding authority.
+
+Before ISSUE 15 every parallel backend hand-rolled its own
+``PartitionSpec`` constructions — ``parallel/tensor_parallel.py`` built
+three spec-tree builders (stacked / layered / q40),
+``parallel/expert_parallel.py`` a fourth, and ``engine/weights.py``
+re-derived in/out shard directions inline at load time. Four copies of
+the same layout knowledge, drifting independently, with silent
+replication as the failure mode when a new leaf matched none of them.
+
+This module replaces all of that with the idiom of SNIPPETS.md [2]
+(JAX_llama): an ordered table of ``(leaf-path regex -> axis template)``
+rules resolved against a named mesh. Differences from the snippet, on
+purpose:
+
+* **Exactly-one-match, not first-match.** An unmatched leaf raises
+  :class:`UnmatchedLeafError` and a leaf matched by two rules raises
+  :class:`AmbiguousLeafError` — both typed, both at load/construction
+  time. Silent replication (the snippet's ``return val`` fallthrough)
+  is exactly the bug class a 405B pod cannot afford: a forgotten rule
+  would quietly materialize a full-size matrix on every chip.
+* **Symbolic axes.** Rules name the :data:`MODEL` / :data:`EXPERT`
+  roles, not concrete mesh axis names; resolution substitutes the
+  caller's mapping (``{"model": "tp"}`` for the classic 1-D TP mesh,
+  ``{"model": "model"}`` for the one-process ``('data','model')`` pod,
+  ``{"model": "tp", "expert": "ep"}`` for the EP mesh). One table
+  serves every mesh shape; axes the mapping leaves out replicate the
+  leaf over them (the pod's ``'data'`` axis never appears in a weight
+  rule — weights live once per model group).
+* **QuantizedMatrix is one leaf.** A q40 weight's ``qs``/``scales``
+  arrays shard along the same logical axis, so a single spec acts as
+  the pytree prefix covering both (the contract ``place_params`` and
+  ``shard_map`` already rely on).
+
+The KV-cache / slab / page-pool layouts ride the same table mechanism
+(:func:`cache_spec`) so "which axis do KV heads shard over" also has
+exactly one home.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Iterator
+
+from jax.sharding import PartitionSpec as P
+
+from distributed_llama_tpu.models.config import LlamaConfig
+
+# Symbolic axis roles substituted at resolve time. Distinct sentinel
+# strings (not bare mesh names) so a rule table can never accidentally
+# hard-code one mesh's axis vocabulary.
+MODEL = "<model>"
+EXPERT = "<expert>"
+
+
+class ShardingRuleError(TypeError):
+    """A weight leaf the rule table cannot place. TypeError on purpose:
+    this is a *structural* mismatch between a params tree and the
+    layout's declared rules, not a bad runtime value."""
+
+
+class UnmatchedLeafError(ShardingRuleError):
+    """A leaf no rule matched — the never-silent-replication contract."""
+
+
+class AmbiguousLeafError(ShardingRuleError):
+    """A leaf two or more rules matched: the table itself is broken."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One ordered table entry: a full-match regex over the '/'-joined
+    leaf path and the axis template its matches shard by."""
+
+    pattern: str
+    axes: tuple
+
+    def matches(self, path: str) -> bool:
+        return re.fullmatch(self.pattern, path) is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleTable:
+    """An ordered, exactly-one-match rule set for one params layout."""
+
+    name: str
+    rules: tuple[Rule, ...]
+
+    def _match(self, path: str) -> Rule:
+        hits = [r for r in self.rules if r.matches(path)]
+        if not hits:
+            raise UnmatchedLeafError(
+                f"sharding table {self.name!r}: weight leaf {path!r} matches "
+                f"no rule — refusing to silently replicate it. Add an "
+                f"explicit rule (replicated leaves must say so)."
+            )
+        if len(hits) > 1:
+            raise AmbiguousLeafError(
+                f"sharding table {self.name!r}: weight leaf {path!r} matches "
+                f"{len(hits)} rules ({[r.pattern for r in hits]}) — exactly "
+                f"one must own every leaf."
+            )
+        return hits[0]
+
+    def spec(self, path: str, axes: dict[str, str | None]) -> P:
+        """The resolved PartitionSpec of one leaf path."""
+        return materialize(self._match(path).axes, axes)
+
+    def resolve(self, tree, axes: dict[str, str | None]):
+        """Spec tree with the structure of ``tree`` (every leaf replaced
+        by its resolved PartitionSpec); raises on unmatched/ambiguous."""
+
+        def rec(node, path: str):
+            if isinstance(node, dict):
+                return {k: rec(v, f"{path}/{k}" if path else k) for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                return [rec(v, f"{path}/{i}") for i, v in enumerate(node)]
+            return self.spec(path, axes)
+
+        return rec(tree, "")
+
+    def table(self, tree, axes: dict[str, str | None]) -> dict[str, P]:
+        """Flat ``{leaf path: resolved spec}`` over a params tree — the
+        golden-test surface (snapshot-asserted so a rule edit that moves
+        a leaf's layout fails loudly)."""
+        return {path: self.spec(path, axes) for path, _ in leaf_paths(tree)}
+
+
+def materialize(template: tuple, axes: dict[str, str | None]) -> P:
+    """Axis template -> PartitionSpec under a role->mesh-axis mapping.
+    A role mapped to None (or absent) replicates that dimension."""
+    out = []
+    for a in template:
+        if a is None:
+            out.append(None)
+        elif a is MODEL:
+            out.append(axes.get("model"))
+        elif a is EXPERT:
+            out.append(axes.get("expert"))
+        else:  # a literal mesh axis name in a template is a table bug
+            raise ShardingRuleError(
+                f"rule template names concrete axis {a!r}; use the MODEL/"
+                f"EXPERT symbols and map them at resolve time"
+            )
+    return P(*out)
+
+
+def leaf_paths(tree, prefix: str = "") -> Iterator[tuple[str, Any]]:
+    """Walk a params tree structurally, yielding ``(path, leaf)`` pairs.
+    dicts/lists/tuples are containers; everything else — arrays and
+    whole :class:`~distributed_llama_tpu.ops.q40.QuantizedMatrix` nodes
+    (qs+scales shard alike, one spec covers both) — is a leaf."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from leaf_paths(v, f"{prefix}/{k}" if prefix else str(k))
+    elif isinstance(tree, P):  # PartitionSpec IS a tuple subclass: a leaf
+        yield prefix, tree
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from leaf_paths(v, f"{prefix}/{i}" if prefix else str(i))
+    else:
+        yield prefix, tree
+
+
+# ---------------------------------------------------------------------------
+# The tables. One per params layout; every leaf of every supported arch
+# (llama dense, Mixtral/Grok MoE) must match exactly one rule — enforced
+# by tests/test_sharding_rules.py over real loaded trees, snapshot-pinned.
+# ---------------------------------------------------------------------------
+
+_L = r"layers/\d+"  # one per-layer subtree of the layered (list) layouts
+_E = r"experts/\d+"
+
+# Rules shared by every layout's top level. Replication is EXPLICIT:
+# embedding / norms / rope are declared replicated, not defaulted.
+_TOP_RULES = (
+    Rule(r"embedding", (None, None)),
+    Rule(r"rms_final", (None,)),
+    Rule(r"rope_table", (None, None, None)),
+)
+
+
+def _wcls_rule(shard_vocab: bool) -> Rule:
+    # vocab-sharded logits head (the reference keeps logits root-only
+    # instead); the all-gather that reassembles them lives in the backend
+    return Rule(r"wcls", (None, MODEL) if shard_vocab else (None, None))
+
+
+def _norm_rules(cfg: LlamaConfig, layer: str, stacked: bool) -> tuple[Rule, ...]:
+    lead: tuple = (None,) if stacked else ()
+    names = ["rms_att", "rms_ffn"]
+    if cfg.arch.name == "GROK1":
+        names += ["rms_moe", "rms_ffn2"]
+    return (Rule(rf"{layer}/({'|'.join(names)})", lead + (None,)),)
+
+
+def _dense_layer_rules(cfg: LlamaConfig, layer: str, stacked: bool) -> tuple[Rule, ...]:
+    """The unfused bf16/f32 layout (one leaf per file matrix): q/k/v and
+    gate/up are output-dim sharded (RowMatmulSlice), wo/down input-dim
+    sharded (ColMatmulSlice) — reference src/commands.cpp:11-73."""
+    lead: tuple = (None,) if stacked else ()
+    rules = [
+        Rule(rf"{layer}/(q|k|v)", lead + (None, MODEL)),
+        Rule(rf"{layer}/wo", lead + (MODEL, None)),
+        *_norm_rules(cfg, layer, stacked),
+    ]
+    if cfg.is_moe:
+        rules += [
+            Rule(rf"{layer}/router", lead + (None, None)),
+            # TP-sliced expert banks [E, D, Hl]/[E, Hl, D]: every shard
+            # holds a 1/tp hidden-slice of ALL experts (the reference's
+            # MoE layout, src/transformer.cpp:335-353)
+            Rule(rf"{layer}/(moe_up|moe_gate)", lead + (None, None, MODEL)),
+            Rule(rf"{layer}/moe_down", lead + (None, MODEL, None)),
+        ]
+    else:
+        rules += [
+            Rule(rf"{layer}/(gate|up)", lead + (None, MODEL)),
+            Rule(rf"{layer}/down", lead + (MODEL, None)),
+        ]
+    return tuple(rules)
+
+
+def _q40_layer_rules(cfg: LlamaConfig, layer: str) -> tuple[Rule, ...]:
+    """The fused q40 per-layer-list layout: qkv / gate_up pack several
+    output-sharded matrices into one QuantizedMatrix leaf; per-expert
+    leaves follow the dense FFN pattern."""
+    rules = [
+        Rule(rf"{layer}/qkv", (None, MODEL)),
+        Rule(rf"{layer}/wo", (MODEL, None)),
+        *_norm_rules(cfg, layer, stacked=False),
+    ]
+    if cfg.is_moe:
+        rules += [
+            Rule(rf"{layer}/router", (None, None)),
+            Rule(rf"{layer}/{_E}/gate_up", (None, MODEL)),
+            Rule(rf"{layer}/{_E}/down", (MODEL, None)),
+        ]
+    else:
+        rules += [
+            Rule(rf"{layer}/gate_up", (None, MODEL)),
+            Rule(rf"{layer}/down", (MODEL, None)),
+        ]
+    return tuple(rules)
+
+
+def _ep_layer_rules(cfg: LlamaConfig, layer: str, quantized: bool) -> tuple[Rule, ...]:
+    """Expert-parallel layouts: expert banks stack on a leading expert
+    axis sharded over EXPERT, hidden still sharded over MODEL; the rest
+    of the layer follows the matching dense/q40 rules."""
+    if quantized:
+        base = [r for r in _q40_layer_rules(cfg, layer)
+                if "experts/" not in r.pattern]
+        return tuple(base) + (
+            Rule(rf"{layer}/experts_gate_up", (EXPERT, None, MODEL)),
+            Rule(rf"{layer}/experts_down", (EXPERT, MODEL, None)),
+        )
+    base = [r for r in _dense_layer_rules(cfg, layer, stacked=False)
+            if "moe_" not in r.pattern]
+    return tuple(base) + (
+        Rule(rf"{layer}/(moe_up|moe_gate)", (EXPERT, None, MODEL)),
+        Rule(rf"{layer}/moe_down", (EXPERT, MODEL, None)),
+    )
+
+
+LAYOUTS = ("layered", "stacked", "q40", "ep", "ep_q40")
+
+
+def param_rules(cfg: LlamaConfig, layout: str, shard_vocab: bool) -> RuleTable:
+    """The ordered rule table of one params layout.
+
+    * ``layered`` — per-layer-list bf16/f32 (the engine's production
+      dense layout, ``engine.weights.load_params``)
+    * ``stacked`` — leading-layer-axis bf16/f32 (synthetic/test trees)
+    * ``q40`` — per-layer-list fused q40 (QuantizedMatrix leaves)
+    * ``ep`` / ``ep_q40`` — expert-parallel stacked expert banks
+    """
+    layer = _L if layout != "stacked" else "layers"
+    if layout in ("layered", "stacked"):
+        layer_rules = _dense_layer_rules(cfg, layer, stacked=layout == "stacked")
+    elif layout == "q40":
+        layer_rules = _q40_layer_rules(cfg, layer)
+    elif layout in ("ep", "ep_q40"):
+        layer_rules = _ep_layer_rules(cfg, layer, quantized=layout == "ep_q40")
+    else:
+        raise ValueError(f"unknown params layout {layout!r} (one of {LAYOUTS})")
+    return RuleTable(
+        name=f"{layout}/{cfg.arch.name.lower()}",
+        rules=_TOP_RULES + (_wcls_rule(shard_vocab),) + layer_rules,
+    )
+
+
+def params_skeleton(cfg: LlamaConfig, layout: str, n_layers: int | None = None):
+    """Structure-only params tree (every leaf ``None``) for one layout —
+    lets spec trees be built from a config alone, without weights. The
+    golden test pins this against trees the REAL loaders build, so the
+    skeleton and ``engine.weights`` cannot drift apart."""
+    n_layers = cfg.n_layers if n_layers is None else n_layers
+
+    def layer():
+        t: dict[str, Any] = {}
+        if layout in ("layered", "stacked", "ep"):
+            t.update(q=None, k=None, v=None, wo=None)
+        else:
+            t.update(qkv=None, wo=None)
+        t.update(rms_att=None, rms_ffn=None)
+        if cfg.is_moe:
+            t["router"] = None
+            if layout == "q40":
+                t["experts"] = [
+                    {"gate_up": None, "down": None} for _ in range(cfg.n_experts)
+                ]
+            elif layout == "ep_q40":
+                t.update(experts_gate_up=None, experts_down=None)
+            else:  # layered / stacked / ep: stacked banks
+                t.update(moe_up=None, moe_gate=None, moe_down=None)
+        elif layout in ("q40", "ep_q40"):
+            t.update(gate_up=None, down=None)
+        else:
+            t.update(gate=None, down=None, up=None)
+        if cfg.arch.name == "GROK1":
+            t.update(rms_moe=None, rms_ffn2=None)
+        return t
+
+    layers: Any
+    if layout == "stacked":
+        layers = layer()
+    else:
+        layers = [layer() for _ in range(n_layers)]
+    return {
+        "embedding": None,
+        "layers": layers,
+        "rms_final": None,
+        "wcls": None,
+        "rope_table": None,
+    }
+
+
+def param_specs(
+    cfg: LlamaConfig,
+    layout: str,
+    shard_vocab: bool,
+    axes: dict[str, str | None],
+    n_layers: int | None = None,
+):
+    """Spec tree for one layout from the rule table — the lookup every
+    backend's hand-rolled builder reduced to (ISSUE 15)."""
+    return param_rules(cfg, layout, shard_vocab).resolve(
+        params_skeleton(cfg, layout, n_layers), axes
+    )
+
+
+# ---------------------------------------------------------------------------
+# KV-cache / slab / page-pool layouts: same mechanism, one home. These
+# are indexed by kind, not path regex — cache trees are homogeneous
+# per-layer tuples, so the "which axis do KV heads / sequence slots
+# shard over" fact is the whole table.
+# ---------------------------------------------------------------------------
+
+SEQ = "<seq>"  # the sequence-parallel axis role (context_parallel)
+
+CACHE_AXES: dict[str, tuple] = {
+    # stacked whole-model cache [L, 2, S, K, hd]: KV heads over MODEL
+    "stacked": (None, None, None, MODEL, None),
+    # per-layer (keys, values) tuples of [S, K, hd]
+    "stream": (None, MODEL, None),
+    # sequence-sharded per-layer stream cache [S, K, hd] (sp backends)
+    "stream_sp": (SEQ, MODEL, None),
+    # batched slab [B, S, K, hd]: batch/sequence replicated
+    "slab": (None, None, MODEL, None),
+    # prefix-cache page pool [P, page, K, hd]
+    "pool": (None, None, MODEL, None),
+}
+
+
+def cache_spec(kind: str, axes: dict[str, str | None]) -> P:
+    """Resolved cache-layout spec (one spec is the pytree prefix covering
+    a QuantizedKV half's data+scales leaves, which shard alike)."""
+    template = CACHE_AXES[kind]
+    out = []
+    for a in template:
+        if a is SEQ:
+            out.append(axes.get("seq"))
+        elif a is MODEL:
+            out.append(axes.get("model"))
+        else:
+            out.append(None)
+    return P(*out)

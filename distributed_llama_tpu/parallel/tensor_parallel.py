@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
+from distributed_llama_tpu.parallel import sharding
 
 try:  # jax >= 0.4.35 exposes shard_map at jax.shard_map
     from jax import shard_map as _shard_map_mod  # type: ignore
@@ -73,107 +74,61 @@ def validate_tp(cfg: LlamaConfig, tp: int, quantized: bool = False) -> None:
                 )
 
 
-def layer_param_specs(cfg: LlamaConfig) -> dict[str, P]:
-    """PartitionSpecs for the stacked per-layer tree (leading axis = layer)."""
-    specs: dict[str, P] = {
-        "q": P(None, None, "tp"),  # [L, D, H*hd] — output sharded
-        "k": P(None, None, "tp"),
-        "v": P(None, None, "tp"),
-        "wo": P(None, "tp", None),  # [L, H*hd, D] — input sharded
-        "rms_att": P(None, None),
-        "rms_ffn": P(None, None),
-    }
-    if cfg.is_moe:
-        specs.update(
-            router=P(None, None, None),  # [L, D, E] replicated
-            moe_up=P(None, None, None, "tp"),  # [L, E, D, Hl]
-            moe_gate=P(None, None, None, "tp"),
-            moe_down=P(None, None, "tp", None),  # [L, E, Hl, D]
-        )
-    else:
-        specs.update(
-            gate=P(None, None, "tp"),  # [L, D, hidden]
-            down=P(None, "tp", None),  # [L, hidden, D]
-            up=P(None, None, "tp"),
-        )
-    if cfg.arch.name == "GROK1":
-        specs.update(rms_moe=P(None, None), rms_ffn2=P(None, None))
-    return specs
+def layer_param_specs(cfg: LlamaConfig, axis: str = "tp") -> dict[str, P]:
+    """PartitionSpecs for the stacked per-layer tree (leading axis = layer).
+    A rule-table lookup (parallel/sharding.py — the one sharding
+    authority); kept as the historical call surface."""
+    return sharding.param_specs(
+        cfg, "stacked", shard_vocab=False, axes={"model": axis}
+    )["layers"]
 
 
-def param_specs(cfg: LlamaConfig, shard_vocab: bool) -> dict[str, Any]:
-    return {
-        "embedding": P(None, None),
-        "layers": layer_param_specs(cfg),
-        "rms_final": P(None),
-        "wcls": P(None, "tp") if shard_vocab else P(None, None),
-        "rope_table": P(None, None, None),
-    }
+def param_specs(cfg: LlamaConfig, shard_vocab: bool, axis: str = "tp") -> dict[str, Any]:
+    return sharding.param_specs(cfg, "stacked", shard_vocab, {"model": axis})
 
 
-def param_specs_layered(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[str, Any]:
+def param_specs_layered(
+    cfg: LlamaConfig, n_layers: int, shard_vocab: bool, axis: str = "tp"
+) -> dict[str, Any]:
     """Specs for the per-layer-list params layout (engine.weights.load_params):
-    each layer's specs are the stacked specs with the leading layer axis
-    stripped."""
-    single = {k: P(*s[1:]) for k, s in layer_param_specs(cfg).items()}
-    return {
-        "embedding": P(None, None),
-        "layers": [dict(single) for _ in range(n_layers)],
-        "rms_final": P(None),
-        "wcls": P(None, "tp") if shard_vocab else P(None, None),
-        "rope_table": P(None, None, None),
-    }
+    a rule-table lookup over the layered skeleton."""
+    return sharding.param_specs(
+        cfg, "layered", shard_vocab, {"model": axis}, n_layers=n_layers
+    )
 
 
-def q40_layer_specs(cfg: LlamaConfig) -> dict[str, P]:
+def q40_layer_specs(cfg: LlamaConfig, axis: str = "tp") -> dict[str, P]:
     """PartitionSpecs for ONE layer of the q40 per-layer-list layout
     (fused qkv/gate_up, QuantizedMatrix leaves — a spec here is a pytree
     prefix covering both the qs and scales arrays, which shard alike)."""
-    specs: dict[str, P] = {
-        "qkv": P(None, "tp"),  # output-dim sharded (q|k|v each split 1/tp)
-        "wo": P("tp", None),  # input-dim sharded
-        "rms_att": P(None),
-        "rms_ffn": P(None),
-    }
-    if cfg.is_moe:
-        specs.update(
-            router=P(None, None),
-            # per-expert q40 leaves (engine.weights): each expert's fused
-            # gate|up is output-sharded, its down input-sharded, like the
-            # dense FFN
-            experts=[
-                {"gate_up": P(None, "tp"), "down": P("tp", None)}
-                for _ in range(cfg.n_experts)
-            ],
-        )
-    else:
-        specs.update(gate_up=P(None, "tp"), down=P("tp", None))
-    if cfg.arch.name == "GROK1":
-        specs.update(rms_moe=P(None), rms_ffn2=P(None))
-    return specs
+    return sharding.param_specs(
+        cfg, "q40", shard_vocab=False, axes={"model": axis}, n_layers=1
+    )["layers"][0]
 
 
-def q40_param_specs(cfg: LlamaConfig, n_layers: int, shard_vocab: bool) -> dict[str, Any]:
-    return {
-        "embedding": P(None, None),
-        "layers": [q40_layer_specs(cfg) for _ in range(n_layers)],
-        "rms_final": P(None),
-        "wcls": P(None, "tp") if shard_vocab else P(None, None),
-        "rope_table": P(None, None, None),
-    }
+def q40_param_specs(
+    cfg: LlamaConfig, n_layers: int, shard_vocab: bool, axis: str = "tp"
+) -> dict[str, Any]:
+    return sharding.param_specs(
+        cfg, "q40", shard_vocab, {"model": axis}, n_layers=n_layers
+    )
 
 
-CACHE_SPEC = P(None, None, None, "tp", None)  # [L, 2, S, K, hd] on KV heads
-CACHE_SPEC_LAYER = P(None, "tp", None)  # per-layer (keys, values) tuples of [S, K, hd]
+# Resolved cache layouts for the classic 1-D ``tp`` mesh (the table lives
+# in parallel/sharding.py CACHE_AXES; backends on other meshes resolve
+# with their own axis mapping)
+_TP_AXES = {"model": "tp"}
+CACHE_SPEC = sharding.cache_spec("stacked", _TP_AXES)  # [L, 2, S, K, hd]
+CACHE_SPEC_LAYER = sharding.cache_spec("stream", _TP_AXES)  # per-layer [S, K, hd]
 # batched slab cache (engine.batch): per-layer (keys, values) tuples of
 # [B, S, K, hd] — batch and sequence replicated, KV heads sharded
-BATCH_CACHE_SPEC_LAYER = P(None, None, "tp", None)
+BATCH_CACHE_SPEC_LAYER = sharding.cache_spec("slab", _TP_AXES)
 # prefix-cache page pool (engine.prefix_cache): per-layer (keys, values)
 # halves of [P, page, K, hd] — pages and positions replicated, KV heads
 # sharded exactly like the slab, so each shard's paged attention reads ITS
 # OWN pool half through the (replicated) page tables with the same local
 # program as the single-chip path
-POOL_SPEC_LAYER = P(None, None, "tp", None)
+POOL_SPEC_LAYER = sharding.cache_spec("pool", _TP_AXES)
 
 
 def place_params(host_params, specs, mesh) -> Any:
@@ -239,6 +194,23 @@ class TransferProbeMixin:
             self._faults_plan_bound = plan
         return plan
 
+    def _enqueue(self, jitted, *args):
+        """Dispatch a jitted multi-partition program with the backend's
+        enqueue order serialized (when the backend defines a dispatch
+        lock). Concurrent callers sharing one backend — the pod's slice
+        schedulers — would otherwise interleave their per-device enqueues
+        inconsistently, and two in-flight programs spanning overlapping
+        device sets deadlock at their first collectives (observed as a
+        hung serving window; the same race corrupts the CPU client's heap
+        under concurrent python-thread dispatch). The lock covers ONLY
+        the asynchronous enqueue, never a fetch — execution still
+        overlaps."""
+        lock = getattr(self, "_dispatch_lock", None)
+        if lock is None:
+            return jitted(*args)
+        with lock:
+            return jitted(*args)
+
     def transfer_bytes_per_token(self) -> int:
         """Estimated LOGICAL payload bytes the probed collective sequence
         moves per token (f32 activations; backends override with their own
@@ -264,7 +236,7 @@ class TransferProbeMixin:
             sw = Stopwatch()
             # fetch, don't block_until_ready: through a remote PJRT tunnel the
             # latter returns before execution finishes (docs/PERF.md)
-            np.asarray(jitted(*args)[0])
+            np.asarray(self._enqueue(jitted, *args)[0])
             per_token_ms = sw.elapsed_ms() / n_tokens
         if tel.enabled:
             tel.probe_runs.inc()
@@ -277,7 +249,7 @@ class TransferProbeMixin:
         cached = self._decode_cache.get(key)
         if cached is None:
             jitted, args = self.transfer_probe(n_tokens)
-            np.asarray(jitted(*args)[0])  # compile + warm outside the window
+            np.asarray(self._enqueue(jitted, *args)[0])  # compile + warm outside the window
             cached = (jitted, args)
             self._decode_cache[key] = cached
         return cached
@@ -291,6 +263,11 @@ class TensorParallelForward(TransferProbeMixin):
     ``engine.weights.load_params(tp=...)``).
     """
 
+    # the shard_map entry point every program builder routes through; the
+    # pod backend (parallel/pod.py) overrides it with the jax-version
+    # compat wrapper so one-process pod serving runs on container JAX too
+    _shard_map = staticmethod(shard_map)
+
     def __init__(
         self,
         cfg: LlamaConfig,
@@ -298,38 +275,78 @@ class TensorParallelForward(TransferProbeMixin):
         devices=None,
         quantized: bool = False,
         layered: bool | None = None,
+        axis: str = "tp",
+        mesh: Mesh | None = None,
     ):
+        """``axis``/``mesh`` let a subclass run the same program family on
+        a larger named mesh (the one-process pod backend rides a
+        ('data', 'model') mesh with ``axis='model'``; every spec below
+        resolves through the rule table with that mapping, replicating
+        over any axis the mapping never names)."""
         validate_tp(cfg, tp, quantized=quantized)
         self.cfg = cfg
         self.tp = tp
+        self.axis = axis
         self.quantized = quantized
         # layered = per-layer-list params + cache (the engine's production
         # layout for every dtype); stacked remains for synthetic-params
         # callers (tests, the driver dryrun)
         self.layered = quantized if layered is None else layered
-        if devices is None:
-            devices = jax.devices()[:tp]
-        if len(devices) < tp:
-            raise ValueError(f"need {tp} devices, have {len(devices)}")
-        self.mesh = Mesh(mesh_utils.create_device_mesh((tp,), devices=devices), ("tp",))
+        if mesh is not None:
+            if axis not in mesh.axis_names or mesh.shape[axis] != tp:
+                raise ValueError(
+                    f"mesh axis {axis!r} of size {tp} required, got "
+                    f"{dict(mesh.shape)}"
+                )
+            self.mesh = mesh
+        else:
+            if devices is None:
+                devices = jax.devices()[:tp]
+            if len(devices) < tp:
+                raise ValueError(f"need {tp} devices, have {len(devices)}")
+            self.mesh = Mesh(
+                mesh_utils.create_device_mesh((tp,), devices=devices), (axis,)
+            )
         self.shard_vocab = cfg.vocab_size % tp == 0
         self._decode_cache: dict = {}
         self._chunk_cache: dict = {}
+        # serializes program ENQUEUE order across callers sharing this
+        # backend (the pod's slice schedulers); see TransferProbeMixin._enqueue
+        import threading as _threading
+
+        self._dispatch_lock = _threading.Lock()
+        axes = {"model": axis}
         if quantized:
-            self._specs = q40_param_specs(cfg, cfg.n_layers, self.shard_vocab)
+            self._specs = q40_param_specs(
+                cfg, cfg.n_layers, self.shard_vocab, axis=axis
+            )
         elif self.layered:
-            self._specs = param_specs_layered(cfg, cfg.n_layers, self.shard_vocab)
+            self._specs = param_specs_layered(
+                cfg, cfg.n_layers, self.shard_vocab, axis=axis
+            )
         else:
-            self._specs = param_specs(cfg, self.shard_vocab)
+            self._specs = param_specs(cfg, self.shard_vocab, axis=axis)
+        # cache/slab/pool layouts from the same rule table (sharding.py)
+        self._stream_cache_spec = sharding.cache_spec("stream", axes)
+        self._slab_spec = sharding.cache_spec("slab", axes)
+        self._pool_spec_layer = sharding.cache_spec("pool", axes)
+        # batched-dispatch vector layouts: per-row scalars ([B] first/pos/
+        # active/sampler/seeds), per-row page tables ([B, n_table]) and the
+        # packed token bundle ([chunk+2, B]). Replicated on the 1-D mesh;
+        # the pod backend re-points them at its 'data' axis when the slab's
+        # batch axis is data-sharded (parallel/pod.py)
+        self._vec_spec = P()
+        self._table_spec = P()
+        self._tok_out_spec = P()
         if self.layered:
             # layered cache (list of per-layer arrays): the unrolled forward
             # needs per-leaf in-place aliasing (see llama.init_cache)
-            self._cache_spec: Any = [CACHE_SPEC_LAYER] * cfg.n_layers
+            self._cache_spec: Any = [self._stream_cache_spec] * cfg.n_layers
         else:
-            self._cache_spec = CACHE_SPEC
+            self._cache_spec = sharding.cache_spec("stacked", axes)
 
-        fn = functools.partial(self._step, cfg)
-        mapped = shard_map(
+        fn = functools.partial(self._step, cfg, self.axis)
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), self._cache_spec, P(), P()),
@@ -343,13 +360,13 @@ class TensorParallelForward(TransferProbeMixin):
     accepts_n_real = True
 
     @staticmethod
-    def _step(cfg, params, tokens, cache, pos, n_real):
+    def _step(cfg, axis, params, tokens, cache, pos, n_real):
         logits, new_cache = llama.forward_tokens(
-            cfg, params, tokens, cache, pos, axis_name="tp", n_real=n_real
+            cfg, params, tokens, cache, pos, axis_name=axis, n_real=n_real
         )
         if logits.shape[-1] != cfg.vocab_size:
             # wcls was vocab-sharded: reassemble full logits on every shard
-            logits = jax.lax.all_gather(logits, "tp", axis=1, tiled=True)
+            logits = jax.lax.all_gather(logits, axis, axis=1, tiled=True)
         return logits, new_cache
 
     # ------------------------------------------------------------------
@@ -412,28 +429,29 @@ class TensorParallelForward(TransferProbeMixin):
             )
 
         P_ = P
+        ax = self.axis
         out = dict(params)
         out["embedding"] = take(params["embedding"], perm_d, 1, P_(None, None))
         out["rms_final"] = take(params["rms_final"], perm_d, 0, P_(None))
-        wcls_spec = P_(None, "tp") if self.shard_vocab else P_(None, None)
+        wcls_spec = P_(None, ax) if self.shard_vocab else P_(None, None)
         out["wcls"] = rows(params["wcls"], wcls_spec)
         layers = []
         for lp in params["layers"]:
             lp = dict(lp)
-            lp["qkv"] = rows(lp["qkv"], P_(None, "tp"))
-            lp["wo"] = cols(lp["wo"], P_("tp", None))
+            lp["qkv"] = rows(lp["qkv"], P_(None, ax))
+            lp["wo"] = cols(lp["wo"], P_(ax, None))
             if "experts" in lp:
                 lp["router"] = take(lp["router"], perm_d, 0, P_(None, None))
                 lp["experts"] = [
                     {
-                        "gate_up": rows(e["gate_up"], P_(None, "tp")),
-                        "down": cols(e["down"], P_("tp", None)),
+                        "gate_up": rows(e["gate_up"], P_(None, ax)),
+                        "down": cols(e["down"], P_(ax, None)),
                     }
                     for e in lp["experts"]
                 ]
             else:
-                lp["gate_up"] = rows(lp["gate_up"], P_(None, "tp"))
-                lp["down"] = cols(lp["down"], P_("tp", None))
+                lp["gate_up"] = rows(lp["gate_up"], P_(None, ax))
+                lp["down"] = cols(lp["down"], P_(ax, None))
             for k in ("rms_att", "rms_ffn", "rms_moe", "rms_ffn2"):
                 if k in lp:
                     lp[k] = take(lp[k], perm_d, 0, P_(None))
@@ -452,13 +470,15 @@ class TensorParallelForward(TransferProbeMixin):
 
         cfg = self.cfg
 
+        axis = self.axis
+
         def fn(params, first_token, cache, pos, seed):
             return sampling.decode_scan(
                 cfg, params, first_token, cache, pos, seed, n_steps,
-                temperature, topp, topk, axis_name="tp",
+                temperature, topp, topk, axis_name=axis,
             )
 
-        mapped = shard_map(
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), self._cache_spec, P(), P()),
@@ -482,7 +502,8 @@ class TensorParallelForward(TransferProbeMixin):
         jitted = self._decode_jitted(
             int(n_steps), float(temperature), float(topp), int(topk)
         )
-        tokens, cache = jitted(
+        tokens, cache = self._enqueue(
+            jitted,
             params, jnp.asarray(first_token), cache, jnp.asarray(pos),
             jnp.uint32(prng.fold_seed(seed)),
         )
@@ -496,13 +517,15 @@ class TensorParallelForward(TransferProbeMixin):
 
         cfg = self.cfg
 
+        axis = self.axis
+
         def fn(params, first_token, cache, pos, temperature, topp, topk, seed):
             return sampling.decode_scan(
                 cfg, params, first_token, cache, pos, seed, n_steps,
-                temperature, topp, topk, axis_name="tp",
+                temperature, topp, topk, axis_name=axis,
             )
 
-        mapped = shard_map(
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), self._cache_spec, P(), P(), P(), P(), P()),
@@ -522,7 +545,8 @@ class TensorParallelForward(TransferProbeMixin):
         recompiles); coins re-key per position from the folded request
         seed, so no sampler state returns."""
         jitted = self._chunk_jitted(int(n_steps))
-        return jitted(
+        return self._enqueue(
+            jitted,
             params, jnp.asarray(first_token), cache, jnp.asarray(pos),
             jnp.float32(temperature), jnp.float32(topp), jnp.int32(topk),
             jnp.asarray(seed32, jnp.uint32),
@@ -540,6 +564,7 @@ class TensorParallelForward(TransferProbeMixin):
         rests on)."""
         cfg = self.cfg
         shard_vocab = self.shard_vocab
+        axis = self.axis
         vshard = cfg.vocab_size // self.tp if shard_vocab else cfg.vocab_size
 
         def token_step(carry, _):
@@ -552,13 +577,13 @@ class TensorParallelForward(TransferProbeMixin):
                 # production decode actually rides
                 from distributed_llama_tpu.ops import collectives
 
-                c = collectives.all_reduce(c, "tp") * 0.5
-                c = collectives.all_reduce(c, "tp") * 0.5
+                c = collectives.all_reduce(c, axis) * 0.5
+                c = collectives.all_reduce(c, axis) * 0.5
                 return c, None
 
             x, _ = jax.lax.scan(layer_step, x, None, length=cfg.n_layers)
             if shard_vocab:
-                g = jax.lax.all_gather(lg, "tp", axis=1, tiled=True)
+                g = jax.lax.all_gather(lg, axis, axis=1, tiled=True)
                 lg = lg + jnp.sum(g) * 1e-9  # keep the gather live
             return (x, lg), None
 
@@ -566,11 +591,11 @@ class TensorParallelForward(TransferProbeMixin):
             (x, lg), _ = jax.lax.scan(token_step, (x, lg), None, length=n_tokens)
             return x, lg
 
-        mapped = shard_map(
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(P(), P(None, "tp") if shard_vocab else P()),
-            out_specs=(P(), P(None, "tp") if shard_vocab else P()),
+            in_specs=(P(), P(None, axis) if shard_vocab else P()),
+            out_specs=(P(), P(None, axis) if shard_vocab else P()),
             check_vma=False,
         )
         x = jnp.ones((1, cfg.dim), jnp.float32)
@@ -591,7 +616,7 @@ class TensorParallelForward(TransferProbeMixin):
 
         kv_shape = (self.cfg.seq_len, self.cfg.n_kv_heads, self.cfg.head_size)
         if self.layered:  # per-layer (keys, values) tuples (see _cache_spec)
-            sharding = NamedSharding(self.mesh, CACHE_SPEC_LAYER)
+            sharding = NamedSharding(self.mesh, self._stream_cache_spec)
 
             def zeros(shape, dt):
                 # shape is GLOBAL; build the local kv-head shard (the spec
@@ -607,7 +632,7 @@ class TensorParallelForward(TransferProbeMixin):
         if kvc.is_quantized_cache_dtype(dtype):
             raise ValueError("the i8 KV cache requires the layered cache layout")
         shape = (self.cfg.n_layers, 2) + kv_shape
-        sharding = NamedSharding(self.mesh, CACHE_SPEC)
+        sharding = NamedSharding(self.mesh, self._cache_spec)
         per_shard = shape[:3] + (shape[3] // self.tp,) + shape[4:]
         zeros = np.zeros(per_shard, dtype)
         return jax.make_array_from_callback(shape, sharding, lambda idx: zeros)
@@ -616,8 +641,9 @@ class TensorParallelForward(TransferProbeMixin):
         tokens = jnp.asarray(tokens)
         if n_real is None:
             n_real = tokens.shape[0]
-        return self._jitted(
-            params, tokens, cache, jnp.asarray(pos), jnp.int32(n_real)
+        return self._enqueue(
+            self._jitted, params, tokens, cache, jnp.asarray(pos),
+            jnp.int32(n_real),
         )
 
     # ------------------------------------------------------------------
@@ -628,6 +654,34 @@ class TensorParallelForward(TransferProbeMixin):
     # engine's production layout for every dtype).
     # ------------------------------------------------------------------
 
+    # -- slab row seam: the pod backend overrides these three to gather/
+    # -- scatter one row across its data-sharded batch axis; here they are
+    # -- the plain local ops (all run INSIDE the shard_map'd bodies)
+
+    def _local_slab_shape(self, gshape: tuple) -> tuple:
+        """One device's shard of a GLOBAL slab-half shape [B, S, K, hd]
+        (or its rank-4 scales twin): KV heads divide by the model degree;
+        the pod backend additionally divides the batch axis when its slab
+        is data-sharded."""
+        return gshape[:2] + (gshape[2] // self.tp,) + gshape[3:]
+
+    def _slab_row_take(self, half, row):
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        return kvc.slab_take_row(half, row)
+
+    def _slab_row_put(self, half, new_row, row):
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        return kvc.slab_put_row(half, new_row, row)
+
+    def _slab_publish(self, pool_half, slab_half, row, src_page, page_ids):
+        from distributed_llama_tpu.ops import kv_cache as kvc
+
+        return kvc.publish_row_pages(
+            pool_half, slab_half, row, src_page, page_ids, pool_half.shape[1]
+        )
+
     def init_batch_cache(self, b_max: int, dtype=jnp.float32):
         from distributed_llama_tpu.ops import kv_cache as kvc
 
@@ -635,10 +689,10 @@ class TensorParallelForward(TransferProbeMixin):
             raise ValueError("the batched slab cache requires the layered layout")
         cfg = self.cfg
         shape = (b_max, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
-        sharding = NamedSharding(self.mesh, BATCH_CACHE_SPEC_LAYER)
+        sharding = NamedSharding(self.mesh, self._slab_spec)
 
         def zeros(gshape, dt):
-            local = np.zeros(gshape[:2] + (gshape[2] // self.tp,) + gshape[3:], dt)
+            local = np.zeros(self._local_slab_shape(gshape), dt)
             return jax.make_array_from_callback(gshape, sharding, lambda idx: local)
 
         return [
@@ -655,7 +709,8 @@ class TensorParallelForward(TransferProbeMixin):
         from distributed_llama_tpu.models import sampling
 
         cfg = self.cfg
-        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+        axis = self.axis
+        batch_cache_spec = [self._slab_spec] * cfg.n_layers
 
         def fn(params, first_tokens, cache, pos, active, temperature, topp,
                topk, seeds):
@@ -663,7 +718,7 @@ class TensorParallelForward(TransferProbeMixin):
 
             tokens, cache, h, okf = sampling.batched_decode_scan(
                 cfg, params, first_tokens, cache, pos, active, seeds, n_steps,
-                temperature, topp, topk, axis_name="tp",
+                temperature, topp, topk, axis_name=axis,
             )
             # the fingerprint folds the all-gathered full-vocab logits, so
             # every shard packs the same replicated bundle (integrity.py);
@@ -671,12 +726,13 @@ class TensorParallelForward(TransferProbeMixin):
             # BEFORE that gather (sampling.sharded_topk_indices)
             return integrity.pack_chunk_outputs(tokens, h, okf), cache
 
-        mapped = shard_map(
+        V = self._vec_spec
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), batch_cache_spec, P(), P(), P(), P(),
-                      P(), P()),
-            out_specs=(P(), batch_cache_spec),
+            in_specs=(self._specs, V, batch_cache_spec, V, V, V, V,
+                      V, V),
+            out_specs=(self._tok_out_spec, batch_cache_spec),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -692,7 +748,8 @@ class TensorParallelForward(TransferProbeMixin):
         settings, collectives riding the mesh each step. One compiled
         program per (bucket, chunk) shape; no sampler state returns."""
         jitted = self._batched_chunk_jitted(int(n_steps))
-        return jitted(
+        return self._enqueue(
+            jitted,
             params, jnp.asarray(first_tokens), cache, jnp.asarray(pos),
             jnp.asarray(active), jnp.asarray(temperature), jnp.asarray(topp),
             jnp.asarray(topk), jnp.asarray(seeds),
@@ -706,26 +763,27 @@ class TensorParallelForward(TransferProbeMixin):
         from distributed_llama_tpu.ops import kv_cache as kvc
 
         cfg = self.cfg
-        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+        axis = self.axis
+        batch_cache_spec = [self._slab_spec] * cfg.n_layers
 
         def fn(params, tokens, slab, row, pos, n_real):
             row_cache = [
-                (kvc.slab_take_row(k, row), kvc.slab_take_row(v, row))
+                (self._slab_row_take(k, row), self._slab_row_take(v, row))
                 for k, v in slab
             ]
             logits, new_rows = llama.forward_tokens(
-                cfg, params, tokens, row_cache, pos, axis_name="tp",
+                cfg, params, tokens, row_cache, pos, axis_name=axis,
                 n_real=n_real,
             )
             if logits.shape[-1] != cfg.vocab_size:
-                logits = jax.lax.all_gather(logits, "tp", axis=1, tiled=True)
+                logits = jax.lax.all_gather(logits, axis, axis=1, tiled=True)
             new_slab = [
-                (kvc.slab_put_row(k, nk, row), kvc.slab_put_row(v, nv, row))
+                (self._slab_row_put(k, nk, row), self._slab_row_put(v, nv, row))
                 for (k, v), (nk, nv) in zip(slab, new_rows)
             ]
             return logits, new_slab
 
-        mapped = shard_map(
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), batch_cache_spec, P(), P(), P()),
@@ -741,7 +799,8 @@ class TensorParallelForward(TransferProbeMixin):
         per-request prefill of the batched serving path): the row runs the
         ordinary sharded forward and is written back in place."""
         jitted = self._slab_forward_jitted()
-        return jitted(
+        return self._enqueue(
+            jitted,
             params, jnp.asarray(tokens), slab, jnp.int32(row), jnp.int32(pos),
             jnp.int32(n_real),
         )
@@ -762,7 +821,7 @@ class TensorParallelForward(TransferProbeMixin):
             raise ValueError("the sharded page pool requires the layered layout")
         cfg = self.cfg
         shape = (n_pages, page, cfg.n_kv_heads, cfg.head_size)
-        sharding = NamedSharding(self.mesh, POOL_SPEC_LAYER)
+        sharding = NamedSharding(self.mesh, self._pool_spec_layer)
 
         def zeros(gshape, dt):
             local = np.zeros(gshape[:2] + (gshape[2] // self.tp,) + gshape[3:], dt)
@@ -775,7 +834,7 @@ class TensorParallelForward(TransferProbeMixin):
         ]
 
     def _pool_spec(self):
-        return [(POOL_SPEC_LAYER, POOL_SPEC_LAYER)] * self.cfg.n_layers
+        return [(self._pool_spec_layer, self._pool_spec_layer)] * self.cfg.n_layers
 
     def _publish_pages_jitted(self):
         key = ("publish_pages",)
@@ -784,20 +843,20 @@ class TensorParallelForward(TransferProbeMixin):
             return cached
         from distributed_llama_tpu.ops import kv_cache as kvc
 
-        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * self.cfg.n_layers
+        batch_cache_spec = [self._slab_spec] * self.cfg.n_layers
 
         def fn(slab, pool, page_ids, src_page, row):
             # per-shard publish of the local KV-head slice: the page size is
             # static from the local pool half's shape
             return [
                 (
-                    kvc.publish_row_pages(pk, k, row, src_page, page_ids, pk.shape[1]),
-                    kvc.publish_row_pages(pv, v, row, src_page, page_ids, pv.shape[1]),
+                    self._slab_publish(pk, k, row, src_page, page_ids),
+                    self._slab_publish(pv, v, row, src_page, page_ids),
                 )
                 for (k, v), (pk, pv) in zip(slab, pool)
             ]
 
-        mapped = shard_map(
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(batch_cache_spec, self._pool_spec(), P(), P(), P()),
@@ -813,7 +872,8 @@ class TensorParallelForward(TransferProbeMixin):
         ``page_ids`` on every shard (each shard moves its own KV-head
         slice). The donated pool aliases in place; the slab is read-only."""
         jitted = self._publish_pages_jitted()
-        return jitted(
+        return self._enqueue(
+            jitted,
             slab, pool, jnp.asarray(page_ids), jnp.asarray(src_page),
             jnp.int32(row),
         )
@@ -826,7 +886,8 @@ class TensorParallelForward(TransferProbeMixin):
         from distributed_llama_tpu.models import sampling
 
         cfg = self.cfg
-        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+        axis = self.axis
+        batch_cache_spec = [self._slab_spec] * cfg.n_layers
 
         def fn(params, first_tokens, cache, pool, pos, active, temperature,
                topp, topk, seeds, tables, matched):
@@ -834,17 +895,18 @@ class TensorParallelForward(TransferProbeMixin):
 
             tokens, cache, h, okf = sampling.batched_decode_scan(
                 cfg, params, first_tokens, cache, pos, active, seeds, n_steps,
-                temperature, topp, topk, axis_name="tp",
+                temperature, topp, topk, axis_name=axis,
                 paged=(pool, tables, matched),
             )
             return integrity.pack_chunk_outputs(tokens, h, okf), cache
 
-        mapped = shard_map(
+        V = self._vec_spec
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(self._specs, P(), batch_cache_spec, self._pool_spec(),
-                      P(), P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(P(), batch_cache_spec),
+            in_specs=(self._specs, V, batch_cache_spec, self._pool_spec(),
+                      V, V, V, V, V, V, self._table_spec, V),
+            out_specs=(self._tok_out_spec, batch_cache_spec),
             check_vma=False,
         )
         jitted = jax.jit(mapped, donate_argnums=(2,))
@@ -861,7 +923,8 @@ class TensorParallelForward(TransferProbeMixin):
         rows beyond — the sharded form of
         ``sampling.decode_chunk_batched_paged``."""
         jitted = self._batched_chunk_paged_jitted(int(n_steps))
-        return jitted(
+        return self._enqueue(
+            jitted,
             params, jnp.asarray(first_tokens), cache, pool, jnp.asarray(pos),
             jnp.asarray(active), jnp.asarray(temperature), jnp.asarray(topp),
             jnp.asarray(topk), jnp.asarray(seeds), jnp.asarray(tables),
@@ -876,26 +939,27 @@ class TensorParallelForward(TransferProbeMixin):
         from distributed_llama_tpu.ops import kv_cache as kvc
 
         cfg = self.cfg
-        batch_cache_spec = [BATCH_CACHE_SPEC_LAYER] * cfg.n_layers
+        axis = self.axis
+        batch_cache_spec = [self._slab_spec] * cfg.n_layers
 
         def fn(params, tokens, slab, pool, row, pos, n_real, table, matched):
             row_cache = [
-                (kvc.slab_take_row(k, row), kvc.slab_take_row(v, row))
+                (self._slab_row_take(k, row), self._slab_row_take(v, row))
                 for k, v in slab
             ]
             logits, new_rows = llama.forward_tokens(
-                cfg, params, tokens, row_cache, pos, axis_name="tp",
+                cfg, params, tokens, row_cache, pos, axis_name=axis,
                 n_real=n_real, paged=(pool, table, matched),
             )
             if logits.shape[-1] != cfg.vocab_size:
-                logits = jax.lax.all_gather(logits, "tp", axis=1, tiled=True)
+                logits = jax.lax.all_gather(logits, axis, axis=1, tiled=True)
             new_slab = [
-                (kvc.slab_put_row(k, nk, row), kvc.slab_put_row(v, nv, row))
+                (self._slab_row_put(k, nk, row), self._slab_row_put(v, nv, row))
                 for (k, v), (nk, nv) in zip(slab, new_rows)
             ]
             return logits, new_slab
 
-        mapped = shard_map(
+        mapped = self._shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(self._specs, P(), batch_cache_spec, self._pool_spec(),
@@ -916,7 +980,8 @@ class TensorParallelForward(TransferProbeMixin):
         ``matched`` (each shard reading its own half) and the slab row
         beyond."""
         jitted = self._slab_forward_paged_jitted()
-        return jitted(
+        return self._enqueue(
+            jitted,
             params, jnp.asarray(tokens), slab, pool, jnp.int32(row),
             jnp.int32(pos), jnp.int32(n_real), jnp.asarray(table),
             jnp.int32(matched),

@@ -1447,14 +1447,42 @@ def serve(args) -> None:
     if spec:
         faults.install(faults.parse(spec, seed=getattr(args, "faults_seed", 0)))
         print(f"⚠️ fault plan active: {spec}")
-    engine, tokenizer, sampler = make_engine(args)
+    if getattr(args, "pod", None):
+        # one-process pod serving (ISSUE 15, docs/SERVING.md): ONE
+        # ('data','model') mesh, one weights tree shared by every slice.
+        # The pod group IS the engine factory — a replica (re)build hands
+        # out a fresh slice engine over the shared params, never a weight
+        # reload — and the replica count is the pod's data extent (each
+        # data slice is one supervised failure domain).
+        from distributed_llama_tpu.apps.cli import make_pod_group
 
-    def engine_factory():
-        # replica (re)builds (ISSUE 9): a fresh engine from the same flags
-        # — the restart supervisor calls this off the serving path, and
-        # the persistent compile cache (configured above) makes the re-jit
-        # a deserialization rather than a rebuild
-        return make_engine(args)[0]
+        group, tokenizer, sampler = make_pod_group(args)
+        wanted = getattr(args, "replicas", None)
+        if wanted == 1:
+            # CONSOLIDATED pod: one supervised replica over the whole
+            # mesh — every lane rides ONE batched-decode program (max
+            # aggregate throughput; the whole pod is one failure domain).
+            # The default (below) trades that for per-slice failover.
+            args.replicas = 1
+        else:
+            if wanted not in (None, group.data):
+                print(
+                    f"⚠️ --replicas {wanted} ignored under --pod: one "
+                    f"replica per data slice ({group.data}), or 1 for the "
+                    "consolidated single-domain pod"
+                )
+            args.replicas = group.data
+        engine = group.slice_engine()
+        engine_factory = group
+    else:
+        engine, tokenizer, sampler = make_engine(args)
+
+        def engine_factory():
+            # replica (re)builds (ISSUE 9): a fresh engine from the same
+            # flags — the restart supervisor calls this off the serving
+            # path, and the persistent compile cache (configured above)
+            # makes the re-jit a deserialization rather than a rebuild
+            return make_engine(args)[0]
 
     state = ApiState(
         engine, tokenizer, sampler, args, engine_factory=engine_factory
@@ -1491,10 +1519,12 @@ def main(argv=None) -> None:
     )
     # replica-loss fault tolerance (ISSUE 9, docs/ROBUSTNESS.md)
     parser.add_argument(
-        "--replicas", type=int, default=1,
+        "--replicas", type=int, default=None,
         help="supervised data-parallel replicas behind one admission front "
         "door: each is an independent engine + batch scheduler failure "
-        "domain (total slots = replicas x --parallel). A dead replica's "
+        "domain (total slots = replicas x --parallel; default 1, or one "
+        "per data slice under --pod — there, an explicit --replicas 1 "
+        "picks the consolidated single-domain pod). A dead replica's "
         "in-flight requests replay bit-identically on survivors while the "
         "supervisor restarts it with jittered backoff; health rides "
         "dispatch round-trips + the stall watchdog (/readyz reports "

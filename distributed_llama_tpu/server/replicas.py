@@ -241,11 +241,13 @@ class ReplicaPool:
     def place(self, messages, deadline: float | None = None, route_tokens=None):
         """Claim a free slot for an admitted request: best chat-prefix
         affinity first (a continuing conversation resumes its own slot's
-        KV), then the replica the SHARED RADIX INDEX says owns the
-        longest published chain of this prompt (``route_tokens`` — the
-        cross-replica prefix routing of ISSUE 11: the Zipf head prefills
-        once globally instead of once per replica), then the least-loaded
-        replica, preferring an empty chat cache on ties. Healthy replicas
+        KV), then the best :meth:`route_score` — the SHARED RADIX INDEX's
+        published chain depth per replica (``route_tokens``, the
+        cross-replica prefix routing of ISSUE 11) DISCOUNTED by its
+        active load, so a marginally-deeper owner drowning in requests
+        loses to a slightly-shallower idle one, while both still beat a
+        cold replica — then the least-loaded replica, preferring an
+        empty chat cache on ties. Healthy replicas
         only while any has room; suspect ones are the fallback; dead ones
         never place — and a dead replica's chains left the index with it,
         so routing never dangles. When nothing is placeable — a replica
@@ -305,6 +307,24 @@ class ReplicaPool:
                     )
                 self._cond.wait(timeout=limit - now)
 
+    # matched-depth x load routing cost model (ROADMAP item 4 follow-up):
+    # one active request on a replica outweighs this many owned prefix
+    # blocks. Pure depth ranking queues behind a loaded owner for a
+    # marginal extra block; pure least-loaded throws owned prefill away
+    # for an idle cold replica — the discounted score beats both
+    # (tests/test_replicas.py::test_depth_discounted_routing_...)
+    ROUTE_LOAD_DISCOUNT = 2.0
+
+    @classmethod
+    def route_score(cls, depth_blocks: int, active: int) -> float:
+        """Depth-discounted load score of placing on a replica that owns
+        ``depth_blocks`` of the prompt's published chain while serving
+        ``active`` requests. With no ownership anywhere the ranking
+        degenerates to least-loaded (the pre-cost-model behavior); among
+        owners, each active request discounts ROUTE_LOAD_DISCOUNT blocks
+        of claimed depth."""
+        return depth_blocks - cls.ROUTE_LOAD_DISCOUNT * active
+
     def _pick_slot_locked(self, messages, shared=None):
         shared = shared or {}
         for wanted in (HEALTHY, SUSPECT):
@@ -320,7 +340,9 @@ class ReplicaPool:
                     cands,
                     key=lambda rs: (
                         rs[1].cache.match_len(messages),
-                        shared.get(rs[0].idx, 0),
+                        self.route_score(
+                            shared.get(rs[0].idx, 0), rs[0].active()
+                        ),
                         -rs[0].active(),
                         0 if rs[1].cache.items else 1,
                     ),

@@ -110,6 +110,42 @@ class TestPoolPlacement:
         # the matching cache wins even though replica 0 is busier
         assert slot is pool.replicas[0].slots[1]
 
+    def test_depth_discounted_routing_beats_pure_rankings(self):
+        """The matched-depth x load cost model (ROADMAP item 4 follow-up):
+        replica 0 owns the DEEPEST chain but is drowning in load, replica
+        2 is idle but owns nothing, replica 1 owns slightly less and is
+        nearly idle. Pure depth ranking picks 0 (queues behind 4 active
+        requests for 2 extra blocks); pure least-loaded picks 2 (throws 6
+        owned blocks of prefill away). The discounted score picks 1 —
+        strictly better than both pure rankings."""
+
+        class FakeIndex:
+            def __init__(self, depths):
+                self.depths = depths
+
+            def match(self, tokens):
+                return dict(self.depths)
+
+            def drop_owner(self, owner):
+                self.depths.pop(owner, None)
+
+        depths = {0: 8, 1: 6}
+        pool = fake_pool(n_replicas=3, lanes=5,
+                         shared_index=FakeIndex(depths))
+        for s in pool.replicas[0].slots[:4]:
+            s.busy = True
+        pool.replicas[1].slots[0].busy = True
+        # what each pure ranking would pick
+        by_depth = max(range(3), key=lambda i: depths.get(i, 0))
+        by_load = min(range(3), key=lambda i: pool.replicas[i].active())
+        assert by_depth == 0 and by_load == 2
+        slot = pool.place([], route_tokens=[1, 2, 3])
+        assert slot in pool.replicas[1].slots  # beats BOTH pure rankings
+        # and with ownership gone the ranking degenerates to least-loaded
+        depths.clear()
+        slot2 = pool.place([], route_tokens=[1, 2, 3])
+        assert slot2 in pool.replicas[2].slots
+
     def test_suspect_is_fallback_dead_never_places(self):
         pool = fake_pool()
         with pool._cond:
