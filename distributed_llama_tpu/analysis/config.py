@@ -33,6 +33,9 @@ class AnalysisConfig:
     observability_doc: str = "docs/OBSERVABILITY.md"
     # FLT-001: the module whose top-level SITES tuple registers fault sites
     fault_registry: str = "distributed_llama_tpu/engine/faults.py"
+    # TRC-001: the module whose top-level SPAN_NAMES tuple registers
+    # trace span names
+    span_registry: str = "distributed_llama_tpu/telemetry/spans.py"
     # LCK-001/002: attribute names that count as "the scheduler lock"
     lock_attrs: tuple[str, ...] = ("_cond",)
     # CLK-001: "relpath" or "relpath::qualname-glob" entries where
@@ -53,6 +56,7 @@ _KEYS = {
     "baseline": str,
     "observability_doc": str,
     "fault_registry": str,
+    "span_registry": str,
     "lock_attrs": tuple,
     "clock_allow": tuple,
     "blocking_calls": tuple,

@@ -9,7 +9,7 @@ from .clock import WallClockRule
 from .donation import DonationRule
 from .exceptions import BaseExceptionRule
 from .locks import BlockingUnderLockRule, LockedCallRule
-from .registries import FaultSiteRule, MetricNameRule
+from .registries import FaultSiteRule, MetricNameRule, SpanNameRule
 
 _RULE_CLASSES = (
     DonationRule,       # DON-001
@@ -19,6 +19,7 @@ _RULE_CLASSES = (
     WallClockRule,      # CLK-001
     MetricNameRule,     # TEL-001
     FaultSiteRule,      # FLT-001
+    SpanNameRule,       # TRC-001
 )
 
 

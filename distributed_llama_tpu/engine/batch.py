@@ -88,7 +88,7 @@ from distributed_llama_tpu.engine.speculative import PromptLookupDrafter
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 from distributed_llama_tpu.ops import kv_cache as kvc
-from distributed_llama_tpu.telemetry import Stopwatch
+from distributed_llama_tpu.telemetry import Stopwatch, flight
 
 
 def decode_bucket(n: int, b_max: int) -> int:
@@ -222,6 +222,11 @@ class BatchStream:
         # evict it for a strictly-higher-priority arrival
         self.tenant: str | None = None
         self.priority: int | None = None
+        # request trace (ISSUE 16): the serving layer hands the row its
+        # request's TraceContext for the request's lifetime (cleared
+        # between requests). The scheduler's shared dispatch/fetch paths
+        # fan per-row child spans into it — one attribute check when None
+        self.trace = None
         # per-request prefix-cache opt-out (the API body's `cache: off`):
         # False skips BOTH the admission match and the post-prefill publish
         # for this row (ISSUE 4); serving restores True between requests
@@ -805,6 +810,17 @@ class BatchScheduler:
         self._lost = True
         self.lost_cause = cause
         self.lost_victims = sum(1 for s in self._streams if s._joined)
+        # flight recorder (ISSUE 16): the death certificate — cause,
+        # victim count, and the victims' request-trace ids, before the
+        # pool's hook records the failover (leaf lock, safe under cond)
+        flight.record(
+            self.replica_id, "replica_lost", cause=cause,
+            corrupt=bool(corrupt), victims=self.lost_victims,
+            victim_trace_ids=[
+                s.trace.request_id for s in self._streams
+                if s.trace is not None
+            ],
+        )
         err_cls = faults.ReplicaCorrupt if corrupt else faults.ReplicaLost
         for s in self._streams:
             s._fetch_error = err_cls(
@@ -864,6 +880,22 @@ class BatchScheduler:
                 with self.engine._depth_lock:
                     self.engine._pipeline_depth -= released
                 tel.watchdog_stalls.inc()
+                flight.record(
+                    self.replica_id, "watchdog_stall",
+                    timeout_s=self.stall_timeout_s,
+                    lost_on_stall=self.lost_on_stall,
+                )
+                if not self.lost_on_stall:
+                    # unsupervised stall: rows die with StallTimeout and no
+                    # replica-death dump follows — snapshot the evidence
+                    # here (the supervised path dumps via the pool's
+                    # failover hook). Outside-the-lock would be nicer, but
+                    # dump() only spawns a writer thread when dump_dir is
+                    # set; the snapshot itself is a leaf-locked copy.
+                    flight.RECORDER.dump(
+                        self.replica_id, "watchdog_stall",
+                        timeout_s=self.stall_timeout_s,
+                    )
                 if self.lost_on_stall:
                     # supervised replica (ISSUE 9): a stalled chunk is a
                     # replica-level loss — victims requeue onto surviving
@@ -992,6 +1024,8 @@ class BatchScheduler:
                 bucket = c  # exact-length compile near the context limit
             padded = np.zeros(bucket, dtype=np.int32)
             padded[:c] = tokens[off : off + c]
+            tr = stream.trace
+            t0 = time.perf_counter() if tr is not None else 0.0
             with self._cond:
                 try:
                     # whole-replica crash site (ISSUE 9): prefill chunk
@@ -1036,6 +1070,14 @@ class BatchScheduler:
                     )
                 stream.pos += c
             off += c
+            if tr is not None:
+                # one child span per dispatched prompt chunk: the trace
+                # shows exactly how a long prompt interleaved with other
+                # rows' decode between these boundaries (ISSUE 16)
+                tr.add_span(
+                    "prefill_chunk", t0, time.perf_counter() - t0,
+                    tokens=c, off=off - c, of=n, row=stream.row,
+                )
         return logits, c - 1
 
     # ------------------------------------------------------------------
@@ -1120,14 +1162,26 @@ class BatchScheduler:
         a peer) are re-uploaded first, so the match sees the full
         reloadable chain."""
         prefix = self._prefix
+        tr = stream.trace
+        t0 = time.perf_counter() if tr is not None else 0.0
+        reloaded = 0
         with self._cond:
             # unwind any stale alias left by a caller that skipped reset
             self._release_pins_locked(stream)
             if prefix.spill is not None and not self._lost:
                 # a dead replica must not re-announce chains to the shared
                 # index after the pool dropped its ownership
-                self._reload_spilled_locked(tokens)
+                reloaded = self._reload_spilled_locked(tokens)
             chain = prefix.match(tokens)
+            if tr is not None:
+                # admission-time cache outcome in the request's own tree:
+                # how much prompt the match skipped, and how many spilled
+                # pages had to re-upload to get there (ISSUE 16)
+                tr.add_span(
+                    "prefix_match", t0, time.perf_counter() - t0,
+                    matched_tokens=len(chain) * prefix.page,
+                    pages=len(chain), reloaded_pages=reloaded,
+                )
             if not chain:
                 return []
             stream._alias_chain = chain
@@ -1597,6 +1651,11 @@ class BatchScheduler:
                 engine._pipeline_depth -= 1
             tel = engine._tel
             tel.rows_quarantined.inc(len(joined))
+            flight.record(
+                self.replica_id, "rows_quarantined",
+                rows=[s.row for s in joined], where="dispatch",
+                error=type(error).__name__,
+            )
             for s in joined:
                 err = faults.RowQuarantined(fail_msg)
                 err.__cause__ = error
@@ -1984,11 +2043,24 @@ class BatchScheduler:
                     s._fetch_error = err
                     self._release_pins_locked(s)
                     tel.rows_quarantined.inc()
+                    flight.record(
+                        self.replica_id, "rows_quarantined", rows=[s.row],
+                        where="fetch", error=type(err).__name__,
+                    )
                     continue
                 s._queue.extend(int(t) for t in toks[:, s.row])
                 s._chunk_fps.append(int(fps[s.row]))
                 s.stats.extend([entry] * self.chunk)
                 delivered += 1
+                if s.trace is not None:
+                    # per-row child of the SHARED dispatch (ISSUE 16): one
+                    # batched chunk fans out into each traced request's own
+                    # tree, spanning dispatch → this delivery
+                    s.trace.add_span(
+                        "batch_decode_chunk_row", sw._t0, sw.elapsed_s(),
+                        row=s.row, chunk=self.chunk, bucket=bucket,
+                        co_batched=n_active,
+                    )
                 if tel.enabled:
                     tel.kv_occupancy.set(
                         min(s.pos / engine.cfg.seq_len, 1.0)
@@ -2066,6 +2138,10 @@ class BatchScheduler:
                     s._fetch_error = err
                     self._release_pins_locked(s)
                     tel.rows_quarantined.inc()
+                    flight.record(
+                        self.replica_id, "rows_quarantined", rows=[s.row],
+                        where="spec_verify", error=type(err).__name__,
+                    )
                     continue
                 col = emits[s.row]
                 n_emit = len(col)
@@ -2076,6 +2152,17 @@ class BatchScheduler:
                 s.stats.append(entries[s.row])
                 delivered_rows += 1
                 delivered_tokens += n_emit
+                if s.trace is not None:
+                    # per-row child of the shared verify step (ISSUE 16);
+                    # drafter_total = the request's lifetime drafted tokens
+                    s.trace.add_span(
+                        "spec_verify_row", sw._t0, sw.elapsed_s(),
+                        row=s.row, drafted=int(lens[s.row]), emitted=n_emit,
+                        drafter_total=(
+                            s._drafter.drafted_total
+                            if s._drafter is not None else 0
+                        ),
+                    )
                 if tel.enabled:
                     tel.kv_occupancy.set(min(s.pos / engine.cfg.seq_len, 1.0))
                     tel.spec_accepted_tokens.inc(n_emit - 1)

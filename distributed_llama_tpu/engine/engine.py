@@ -106,6 +106,11 @@ class EngineStream:
         # have no shared rows to evict
         self.tenant: str | None = None
         self.priority: int | None = None
+        # request trace surface parity with BatchStream (ISSUE 16): the
+        # serving layer stamps it per request; only the batch scheduler
+        # fans per-row spans into it — the independent-stream decode path
+        # records its spans at the serving layer instead
+        self.trace = None
         engine._streams.append(self)
         engine._tel.active_streams.set(len(engine._streams))
 

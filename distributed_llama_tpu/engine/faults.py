@@ -218,6 +218,24 @@ SITES = (
 # stall timeout, short enough that a daemon-threaded test process still exits
 HANG_DEFAULT_MS = 60_000.0
 
+# Fire observers (ISSUE 16): called on every ACTUAL injection with
+# ``(site, rule, row)`` — the flight recorder's feed
+# (telemetry/flight.py), so a dump can always name the chaos site behind
+# a death. Observers run under the plan's lock and must only append to
+# leaf-locked state; a failing observer is swallowed (chaos bookkeeping
+# must never alter the injection it observes).
+_fire_observers: list = []
+
+
+def add_fire_observer(fn) -> None:
+    if fn not in _fire_observers:
+        _fire_observers.append(fn)
+
+
+def remove_fire_observer(fn) -> None:
+    if fn in _fire_observers:
+        _fire_observers.remove(fn)
+
 
 @dataclasses.dataclass
 class FaultRule:
@@ -286,6 +304,11 @@ class FaultPlan:
                     continue
                 self._fired[i] = fired + 1
                 self.injected_total += 1
+                for obs in _fire_observers:
+                    try:
+                        obs(site, r, row)
+                    except Exception:
+                        pass
                 # resolved per injection, NOT bound at construction: an
                 # env-installed plan exists before a --telemetry flag
                 # enables the registry, and injections are rare enough

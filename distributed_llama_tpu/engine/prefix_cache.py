@@ -78,6 +78,7 @@ import threading
 
 from distributed_llama_tpu import telemetry
 from distributed_llama_tpu.engine.spill import SpillCorrupt
+from distributed_llama_tpu.telemetry import flight
 
 
 class SharedPrefixIndex:
@@ -552,8 +553,13 @@ class PrefixCache:
         arrays = None
         try:
             arrays = self.spill.take(self.owner_id, chain)
-        except SpillCorrupt:
-            pass  # own copy corrupt + dropped (counted); try the peers
+        except SpillCorrupt as e:
+            # own copy corrupt + dropped (counted); try the peers. The
+            # flight recorder keeps the CRC verdict (ISSUE 16): a later
+            # replica death dump shows whether its spilled KV was rotting
+            flight.record(
+                self.owner_id, "spill_crc_drop", error=str(e),
+            )
         if arrays is None:
             arrays = self.spill.peek_shared(chain, exclude_owner=self.owner_id)
         self._set_spill_gauges()
@@ -628,6 +634,10 @@ class PrefixCache:
                     # dispatch: the remaining blocks prefill cold
                     # (interpreter exits are not Exception and propagate)
                     print(f"⚠️ spill reload aborted; prefilling cold: {e}")
+                    flight.record(
+                        self.owner_id, "spill_reload_abort",
+                        reloaded=n_reloaded, error=type(e).__name__,
+                    )
                     break
                 key = tuple(tokens[i * page : (i + 1) * page])
                 child = PageNode(key, pid, node)

@@ -66,6 +66,11 @@ class PromptLookupDrafter:
         self._src: list | None = None
         self._buf: np.ndarray | None = None
         self._len = 0
+        # lifetime tokens proposed by this drafter (one drafter per
+        # request): the scheduler surfaces it in the request's trace so a
+        # span tree shows how much of the stream rode on speculation
+        # without a separate metric series per request (ISSUE 16)
+        self.drafted_total = 0
 
     def _as_array(self, history) -> np.ndarray:
         if isinstance(history, np.ndarray):
@@ -91,6 +96,11 @@ class PromptLookupDrafter:
     def draft(self, history: list[int] | np.ndarray, limit: int | None = None) -> list[int]:
         """Up to ``min(k, limit)`` proposed continuation tokens of
         ``history`` (possibly none — no n-gram of the tail recurs)."""
+        out = self._draft(history, limit)
+        self.drafted_total += len(out)
+        return out
+
+    def _draft(self, history, limit: int | None) -> list[int]:
         budget = self.k if limit is None else min(self.k, int(limit))
         h = self._as_array(history)
         n_hist = h.shape[0]

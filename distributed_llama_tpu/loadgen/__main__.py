@@ -173,6 +173,15 @@ def build_parser() -> argparse.ArgumentParser:
         "layer raises no false positives; repeatable",
     )
     p.add_argument(
+        "--expect-flight", action="append", default=[],
+        metavar="KIND[@SITE][:MIN]",
+        help="assert the server's /debug/flight rings hold at least MIN "
+        "(default 1) lifecycle events of KIND, optionally with a given "
+        "fault site — e.g. 'fault_fire@replica.crash:1' proves the chaos "
+        "injection landed in the black box, 'failover:1' the recovery it "
+        "caused; repeatable (ISSUE 16)",
+    )
+    p.add_argument(
         "--canary-interval-s", type=float, default=0.0,
         help="self-host SDC canary period (--sdc-canary-interval-s on "
         "the server): pinned greedy probes per replica compared against "
@@ -293,27 +302,30 @@ def main(argv=None) -> int:
             report["checks"]["expected_zero"] = rep.check_expected_zero(
                 report, args.expect_zero
             )
+        if args.expect_flight:
+            report["checks"]["expected_flight"] = rep.check_expected_flight(
+                rep.fetch_flight(url), args.expect_flight
+            )
         text = rep.dump_report(report, args.out)
         print(text)
         if not replay_ok:
             print("FATAL: schedule replay fingerprint mismatch", file=sys.stderr)
             return 2
         # explicitly requested gates (--goodput-floor/--expect-delta/
-        # --expect-zero) are ALWAYS enforced: asking for a gate and then
+        # --expect-zero/--expect-flight) are ALWAYS enforced: asking for a
+        # gate and then
         # ignoring its verdict tests nothing. --assert additionally
         # enforces the built-in consistency/fairness checks — an SDC
         # chaos run skips it on purpose: requests a corrupt replica
         # served before detection stream wrong-but-completed bodies,
         # which is exactly the failure mode under test, not a harness bug
-        requested = [
-            report["checks"].get(k)
-            for k in ("goodput", "expected_deltas", "expected_zero")
-        ]
+        gate_names = (
+            "goodput", "expected_deltas", "expected_zero", "expected_flight",
+        )
+        requested = [report["checks"].get(k) for k in gate_names]
         bad = [
             f"[{k}] {v}"
-            for k, chk in zip(
-                ("goodput", "expected_deltas", "expected_zero"), requested
-            )
+            for k, chk in zip(gate_names, requested)
             if chk and not chk.get("ok", True)
             for v in chk.get("violations", [])
         ]

@@ -28,6 +28,7 @@ fails on any violation):
 from __future__ import annotations
 
 import json
+import re
 import urllib.request
 
 from distributed_llama_tpu.stats import percentile, summarize
@@ -81,6 +82,13 @@ SERVER_COUNTERS = (
     # the host-sampler fallback (the no-host-round-trip happy path)
     "dllama_device_sampled_tokens_total",
     "dllama_host_sampler_fallback_total",
+    # server-side SLO attribution (ISSUE 16): the fairness smoke gates
+    # --expect-delta on the TTFT count (server-side latency histograms
+    # actually observed traffic); the skew section below reads the
+    # per-tenant stage _sum series directly
+    "dllama_ttft_seconds_count",
+    "dllama_tpot_seconds_count",
+    "dllama_request_stage_seconds_count",
 )
 
 
@@ -128,6 +136,60 @@ def metric_deltas(
         n: round(_sum_series(after, n) - _sum_series(before, n), 3)
         for n in names
     }
+
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _sum_series_by_label(
+    metrics: dict[str, float], base: str, label: str
+) -> dict[str, float]:
+    """Sum ``base{...}`` series grouped by one label's value (e.g. the
+    per-tenant stage-attribution sums)."""
+    out: dict[str, float] = {}
+    for k, v in metrics.items():
+        if not k.startswith(base + "{"):
+            continue
+        labels = dict(_LABEL_RE.findall(k[len(base):]))
+        key = labels.get(label)
+        if key is not None:
+            out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def client_server_skew(
+    results: list["RequestResult"],
+    before: dict[str, float], after: dict[str, float],
+) -> dict:
+    """Per-tenant client-vs-server skew (ISSUE 16): the sum of
+    client-measured E2E over completed requests minus the run delta of
+    the server-attributed `dllama_request_stage_seconds_sum` (all stages,
+    that tenant). The difference is what the server cannot see — network,
+    HTTP framing, client-side queuing. A large skew with healthy server
+    attribution moves the investigation off the server process."""
+    base = "dllama_request_stage_seconds_sum"
+    srv_before = _sum_series_by_label(before, base, "tenant")
+    srv_after = _sum_series_by_label(after, base, "tenant")
+    out: dict[str, dict] = {}
+    for tenant in sorted({r.tenant for r in results}):
+        done = [
+            r for r in results
+            if r.tenant == tenant and r.outcome == "completed"
+            and r.e2e_ms is not None
+        ]
+        client_s = sum(r.e2e_ms for r in done) / 1000.0
+        server_s = srv_after.get(tenant, 0.0) - srv_before.get(tenant, 0.0)
+        out[tenant] = {
+            "completed": len(done),
+            "client_e2e_s": round(client_s, 3),
+            "server_attributed_s": round(server_s, 3),
+            "skew_s": round(client_s - server_s, 3),
+            "skew_per_request_ms": (
+                round((client_s - server_s) / len(done) * 1000.0, 3)
+                if done else None
+            ),
+        }
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -207,6 +269,11 @@ def build_report(
         "tenants": tenants,
         "server": (
             metric_deltas(metrics_before, metrics_after)
+            if metrics_before is not None and metrics_after is not None
+            else None
+        ),
+        "client_vs_server_skew": (
+            client_server_skew(results, metrics_before, metrics_after)
             if metrics_before is not None and metrics_after is not None
             else None
         ),
@@ -402,6 +469,64 @@ def check_expected_zero(report: dict, names: list[str]) -> dict:
                 f"counter {name!r} moved {got:g}, expected exactly 0"
             )
     return {"ok": not violations, "expected_zero": checked,
+            "violations": violations}
+
+
+def fetch_flight(url: str, timeout_s: float = 10.0) -> dict | None:
+    """GET ``url``/debug/flight → the flight-recorder snapshot (ISSUE 16);
+    None on failure (the gate then reports a violation, not a traceback)."""
+    try:
+        with urllib.request.urlopen(
+            url + "/debug/flight", timeout=timeout_s
+        ) as r:
+            return json.loads(r.read().decode())
+    except (OSError, ValueError):
+        return None
+
+
+def check_expected_flight(snapshot: dict | None, specs: list[str]) -> dict:
+    """Gate on flight-recorder lifecycle events (ISSUE 16): each spec is
+    ``kind[@site][:min]`` — at least ``min`` (default 1) events of
+    ``kind`` (optionally with that ``site`` field, for `fault_fire`) must
+    appear across the replica rings. The replica-kill CI smoke gates
+    ``fault_fire@replica.crash:1`` and ``failover:1``: the black box must
+    show the injection AND the recovery it caused."""
+    violations: list[str] = []
+    expected: list[dict] = []
+    if snapshot is None:
+        return {"ok": False, "expected": specs,
+                "violations": ["/debug/flight snapshot unavailable"]}
+    events = [
+        ev for ring in (snapshot.get("replicas") or {}).values()
+        for ev in ring
+    ]
+    for spec in specs:
+        head, colon, floor_s = spec.rpartition(":")
+        if not colon:
+            head, floor_s = spec, ""
+        try:
+            floor = float(floor_s) if floor_s.strip() else 1.0
+        except ValueError:
+            violations.append(
+                f"malformed --expect-flight spec {spec!r} "
+                "(want KIND[@SITE][:MIN])"
+            )
+            continue
+        kind, _, site = head.partition("@")
+        kind, site = kind.strip(), site.strip()
+        got = sum(
+            1 for ev in events
+            if ev.get("kind") == kind
+            and (not site or ev.get("site") == site)
+        )
+        expected.append({"kind": kind, "site": site or None, "min": floor})
+        if got < floor:
+            violations.append(
+                f"flight events kind={kind!r}"
+                + (f" site={site!r}" if site else "")
+                + f": saw {got}, expected >= {floor:g}"
+            )
+    return {"ok": not violations, "expected": expected,
             "violations": violations}
 
 

@@ -208,14 +208,19 @@ class FairAdmission:
 
     def acquire(
         self, tenant: str = DEFAULT_TENANT, priority: int = 0,
-        deadline: float | None = None,
+        deadline: float | None = None, trace=None,
     ) -> None:
         """Take one serving permit for ``tenant`` at ``priority``, queueing
         BOUNDEDLY behind its own tenant queue when all slots are busy.
         Raises :class:`AdmissionRejected` (→429) past the queue bounds,
         :class:`DeadlineExceeded` (→504) when ``deadline`` (a
         ``time.monotonic`` instant) expires in line, and
-        :class:`ServerDraining` (→503) on SIGTERM drain."""
+        :class:`ServerDraining` (→503) on SIGTERM drain.
+
+        ``trace`` (ISSUE 16): the request's TraceContext, annotated when
+        the request actually QUEUED — a fast-path grant leaves no note, so
+        a trace's queue_wait span plus this note distinguish "waited in
+        line behind N others" from "walked straight in"."""
         with self._cond:
             cfg = self._config_locked(tenant)
             tenant = cfg.name  # canonical: past max_tenants, the default bucket
@@ -239,6 +244,11 @@ class FairAdmission:
             w = _Waiter(tenant, priority)
             q.append(w)
             self._waiting += 1
+            queued_behind = self._waiting
+        if trace is not None:
+            trace.note(
+                admission_queued=True, admission_waiters=queued_behind
+            )
         # priority preemption happens OUTSIDE the admission lock: the hook
         # takes the batch scheduler's condition lock, and holding both
         # would order them admission→scheduler while the release path

@@ -71,7 +71,8 @@ from distributed_llama_tpu.server.replicas import (
     Replica,
     ReplicaPool,
 )
-from distributed_llama_tpu.telemetry import Stopwatch
+from distributed_llama_tpu.telemetry import Stopwatch, flight, trace
+from distributed_llama_tpu.telemetry.trace import RequestTraceStore
 from distributed_llama_tpu.tokenizer import (
     ChatItem,
     ChatTemplate,
@@ -290,6 +291,27 @@ class ApiState:
         # server instrument bundle: bound BEFORE the pool so the pool's
         # replica-state gauges land in the same registry bundle
         self.tel = telemetry.ServerInstruments()
+        # request-scoped tracing (ISSUE 16, telemetry/trace.py): the
+        # bounded store behind GET /debug/trace/<id>. None with telemetry
+        # off — every per-request hook downstream is then a single
+        # `ctx is None` attribute check (the PR 1 zero-overhead contract)
+        self.traces: RequestTraceStore | None = None
+        if telemetry.is_enabled():
+            sample = getattr(args, "trace_sample_rate", None)
+            slow = getattr(args, "trace_slow_ttft_s", None)
+            retention = getattr(args, "trace_retention", None)
+            self.traces = RequestTraceStore(
+                capacity=256 if retention is None else int(retention),
+                sample_rate=1.0 if sample is None else float(sample),
+                slow_ttft_s=1.0 if slow is None else float(slow),
+            )
+        # flight recorder (ISSUE 16, telemetry/flight.py): always on —
+        # lifecycle events are rare; arm the fault-fire observer and the
+        # optional on-death JSON artifact directory
+        flight.install_fault_observer()
+        dump_dir = getattr(args, "flight_dump_dir", None)
+        if dump_dir:
+            flight.RECORDER.dump_dir = str(dump_dir)
         # the supervised replica pool (ISSUE 9, server/replicas.py):
         # placement, health (healthy → suspect → dead off dispatch
         # round-trips + the stall watchdog), capacity resize on death,
@@ -610,6 +632,7 @@ class ApiState:
     def _acquire_slot(
         self, messages: list[dict], deadline: float | None = None,
         tenant: str = DEFAULT_TENANT, priority: int = 0, route_tokens=None,
+        ctx=None,
     ) -> StreamSlot:
         """Take a free lane through weighted-fair admission: when all are
         busy the request queues BOUNDEDLY under its own tenant (excess get
@@ -625,7 +648,8 @@ class ApiState:
         sw = Stopwatch()
         tel = self.tel
         try:
-            self.admission.acquire(tenant, priority, deadline)
+            with trace.span(ctx, "queue_wait"):
+                self.admission.acquire(tenant, priority, deadline, trace=ctx)
         except AdmissionRejected:
             tel.admission_rejected.inc()
             tel.tenant_rejected.labels(tenant=tenant).inc()
@@ -639,11 +663,18 @@ class ApiState:
             # back and bounce — the drain waiter counts acquirable slots
             self.admission.release()
             raise ServerDraining("server is draining; not admitting")
-        tel.queue_wait.observe(sw.elapsed_s())
+        queue_s = sw.elapsed_s()
+        tel.queue_wait.observe(queue_s)
         tel.tenant_admitted.labels(tenant=tenant).inc()
         tel.tenant_active.labels(tenant=tenant).inc()
+        if ctx is not None:
+            ctx.add_stage("queue", queue_s)
+        sw.restart()
         try:
-            slot = self.pool.place(messages, deadline, route_tokens=route_tokens)
+            with trace.span(ctx, "placement"):
+                slot = self.pool.place(
+                    messages, deadline, route_tokens=route_tokens
+                )
         except BaseException:
             # placement raced a replica death (or the deadline): give the
             # permit back — a raised ReplicaLost re-enters the requeue
@@ -651,6 +682,8 @@ class ApiState:
             self.admission.release()
             tel.tenant_active.labels(tenant=tenant).dec()
             raise
+        if ctx is not None:
+            ctx.add_stage("placement", sw.elapsed_s())
         slot.tenant = tenant
         return slot
 
@@ -708,6 +741,14 @@ class ApiState:
         # splice onto the first run's already-sent deltas
         if params.get("seed") is None:
             params["seed"] = int(time.time_ns() % (1 << 31))
+        # request trace (ISSUE 16): one context for the WHOLE requeue loop
+        # — failover/preemption replays become sibling attempts in one
+        # tree, never separate traces. None when telemetry is off.
+        traces = self.traces
+        ctx = (
+            traces.begin(request_id, tenant) if traces is not None else None
+        )
+        attempts = 0
         sent = 0
         skip = 0
 
@@ -722,15 +763,27 @@ class ApiState:
         route_tokens = self._route_tokens(params)
 
         def attempt_once():
-            nonlocal skip
+            nonlocal attempts, skip
             skip = sent  # re-runs replay (and suppress) what was delivered
+            if ctx is not None:
+                # attempt > 0 is a requeue re-run: tagged `replayed` so the
+                # tree distinguishes the original from its failover/
+                # preemption replays, and its stage time folds into the
+                # `replay` attribution bucket (trace.TraceContext)
+                ctx.begin_attempt(replayed=attempts > 0)
+            attempts += 1
             slot = self._acquire_slot(
-                params["messages"], deadline, tenant, priority, route_tokens
+                params["messages"], deadline, tenant, priority, route_tokens,
+                ctx=ctx,
             )
             # the slot's OWN scheduler (its replica's), not replica 0's:
             # request-end bookkeeping must land on the scheduler that
             # actually served the row
             sched = getattr(slot.stream, "scheduler", None)
+            if ctx is not None:
+                ctx.set_replica(
+                    sched.replica_id if sched is not None else 0
+                )
             try:
                 slot.stream.deadline = deadline
                 # per-request prefix-cache opt-out (`cache: off` in the
@@ -741,15 +794,19 @@ class ApiState:
                 # label the row for preempt_below's victim selection
                 slot.stream.tenant = tenant
                 slot.stream.priority = priority
+                # hand the row its trace so the scheduler's shared chunk
+                # dispatches can fan per-row child spans into this tree
+                slot.stream.trace = ctx
                 return self._complete_on(
                     slot, params, guarded_send, request_id, deadline,
-                    route_tokens=route_tokens,
+                    route_tokens=route_tokens, ctx=ctx,
                 )
             finally:
                 slot.stream.deadline = None
                 slot.stream.prefix_cache_enabled = True
                 slot.stream.tenant = None
                 slot.stream.priority = None
+                slot.stream.trace = None
                 if sched is not None:
                     # drop an unconsumed eviction marker (the request beat
                     # its preemption to the finish line) so it cannot leak
@@ -787,11 +844,28 @@ class ApiState:
             else:
                 self.tel.preempt_requeues.inc()
 
-        result = retry.retry_call(
-            attempt_once, REQUEUE_POLICY,
-            retry_on=(faults.RowPreempted, faults.ReplicaLost),
-            on_retry=on_requeue,
-        )
+        try:
+            result = retry.retry_call(
+                attempt_once, REQUEUE_POLICY,
+                retry_on=(faults.RowPreempted, faults.ReplicaLost),
+                on_retry=on_requeue,
+            )
+        finally:
+            if ctx is not None:
+                # server-side SLO surface: TTFT/TPOT and the stage
+                # breakdown observe the SAME timestamps the trace tree
+                # reports, so /metrics and /debug/trace/<id> can never
+                # disagree about what they measured. In the finally: a
+                # failed request still attributes where its time went.
+                if ctx.ttft_s is not None:
+                    self.tel.ttft.labels(tenant=tenant).observe(ctx.ttft_s)
+                if ctx.tpot_s is not None:
+                    self.tel.tpot.labels(tenant=tenant).observe(ctx.tpot_s)
+                for stg, seconds in dict(ctx.stages).items():
+                    self.tel.stage_seconds.labels(
+                        stage=stg, tenant=tenant
+                    ).observe(seconds)
+                traces.finish(ctx)
         # shadow voting samples completed greedy requests (ISSUE 10):
         # off-path, after the client already has its stream/result
         self._maybe_shadow(params)
@@ -799,10 +873,14 @@ class ApiState:
 
     def _complete_on(
         self, slot: StreamSlot, params: dict, send_chunk, request_id: str,
-        deadline: float | None = None, route_tokens=None,
+        deadline: float | None = None, route_tokens=None, ctx=None,
     ) -> dict | None:
         engine, tokenizer = slot.stream, self.tokenizer
         stream = params["stream"]
+        # stage attribution clock (ISSUE 16): prefill = entry → prefill
+        # dispatch returned (tokenize + cache resolve + dispatch), decode =
+        # the rest of the token loop. Measured only for traced requests.
+        stage_sw = Stopwatch() if ctx is not None else None
         if deadline is not None and time.monotonic() >= deadline:
             raise DeadlineExceeded("deadline expired before prefill")
 
@@ -859,15 +937,23 @@ class ApiState:
         slot.sampler.set_seed(seed)
 
         device_decode = getattr(self.args, "decode", "device") == "device" and max_new > 0
-        if device_decode:
-            # prefill→decode fusion: the first generated token is sampled on
-            # device and never visits the host before chunk 1 is dispatched —
-            # one tunnel round trip per request instead of two (docs/PERF.md)
-            first_dev = engine.prefill_device(
-                prompt_tokens, params["temperature"], topp, seed, topk
-            )
-        else:
-            logits = engine.prefill(prompt_tokens)
+        with trace.span(
+            ctx, "prefill", tokens=len(prompt_tokens), start_pos=start_pos,
+            fused=device_decode,
+        ):
+            if device_decode:
+                # prefill→decode fusion: the first generated token is
+                # sampled on device and never visits the host before chunk 1
+                # is dispatched — one tunnel round trip per request instead
+                # of two (docs/PERF.md)
+                first_dev = engine.prefill_device(
+                    prompt_tokens, params["temperature"], topp, seed, topk
+                )
+            else:
+                logits = engine.prefill(prompt_tokens)
+        if ctx is not None:
+            ctx.add_stage("prefill", stage_sw.elapsed_s())
+            stage_sw.restart()
 
         max_stop = max(len(s) for s in self.stops + params["stop"]) if (self.stops or params["stop"]) else 0
         detector = EosDetector(
@@ -891,6 +977,10 @@ class ApiState:
                     f"deadline expired after {emitted} tokens"
                 )
             emitted += 1
+            if ctx is not None:
+                # the TTFT/TPOT stamp: first mark is time-to-first-token,
+                # the spread of the rest is time-per-output-token
+                ctx.mark_token()
             piece = tokenizer.decode_piece(prev, token)
             res = detector.append(token, piece if is_safe_piece(piece) else b"")
             if res in (EosDetectorResult.NOT_EOS, EosDetectorResult.EOS):
@@ -899,64 +989,76 @@ class ApiState:
                     text = delta.decode("utf-8", errors="replace")
                     buffer.append(text)
                     if stream:
-                        send_chunk(self._chunk_json(text, stop=False, request_id=request_id))
+                        with trace.span(ctx, "sse_send", chars=len(text)):
+                            send_chunk(self._chunk_json(text, stop=False, request_id=request_id))
                 detector.clear()
             return res
 
         res = EosDetectorResult.NOT_EOS
-        if device_decode:  # implies max_new > 0 (see device_decode above)
-            if max_new == 1:
-                # 1-token completion: fetch the fused token directly — a
-                # decode stream would dispatch a whole speculative chunk
-                # whose output is discarded
-                token = engine.fetch_first_token(first_dev)
-                res = feed(prompt_tokens[-1], token)
+        decode_t0 = time.perf_counter()
+        try:
+            if device_decode:  # implies max_new > 0 (see device_decode above)
+                if max_new == 1:
+                    # 1-token completion: fetch the fused token directly — a
+                    # decode stream would dispatch a whole speculative chunk
+                    # whose output is discarded
+                    token = engine.fetch_first_token(first_dev)
+                    res = feed(prompt_tokens[-1], token)
+                    if res == EosDetectorResult.EOS:
+                        finish_reason = "stop"
+                else:
+                    # fast path: chunked on-device decode+sampling (temperature
+                    # and top-p are runtime values — no per-request recompile);
+                    # the fused first token arrives with the stream
+                    def on_token(prev: int, t: int) -> bool:
+                        nonlocal res, finish_reason
+                        res = feed(prev, t)
+                        if res == EosDetectorResult.EOS:
+                            finish_reason = "stop"
+                            return False
+                        return emitted < max_new
+
+                    engine.stream_decode(
+                        first_dev, on_token, params["temperature"], topp,
+                        seed=seed, chunk=getattr(self.args, "decode_chunk", 32),
+                        limit=max_pos, first_prev=prompt_tokens[-1],
+                        # self-speculative decode (--spec-draft k): prompt-lookup
+                        # drafts over this request's prompt + output, verified
+                        # k at a time in one weight read; 0 = plain chunked path
+                        spec_draft=getattr(self.args, "spec_draft", 0),
+                        spec_ngram=getattr(self.args, "spec_ngram", 3),
+                        prompt_tokens=prompt_tokens,
+                        topk=topk,
+                    )
+            else:
+                # --decode host: the per-token fallback regime — every token
+                # pays a logits fetch + host sort, counted by
+                # dllama_host_sampler_fallback_total; the counter-mode sampler
+                # keys each coin on the consumed position, so the stream is
+                # token-identical to the device path per seed
+                if max_new > 0:
+                    token = slot.sampler.sample(logits, pos=engine.pos - 1)
+                    res = feed(prompt_tokens[-1], token)
                 if res == EosDetectorResult.EOS:
                     finish_reason = "stop"
-            else:
-                # fast path: chunked on-device decode+sampling (temperature
-                # and top-p are runtime values — no per-request recompile);
-                # the fused first token arrives with the stream
-                def on_token(prev: int, t: int) -> bool:
-                    nonlocal res, finish_reason
-                    res = feed(prev, t)
-                    if res == EosDetectorResult.EOS:
-                        finish_reason = "stop"
-                        return False
-                    return emitted < max_new
-
-                engine.stream_decode(
-                    first_dev, on_token, params["temperature"], topp,
-                    seed=seed, chunk=getattr(self.args, "decode_chunk", 32),
-                    limit=max_pos, first_prev=prompt_tokens[-1],
-                    # self-speculative decode (--spec-draft k): prompt-lookup
-                    # drafts over this request's prompt + output, verified
-                    # k at a time in one weight read; 0 = plain chunked path
-                    spec_draft=getattr(self.args, "spec_draft", 0),
-                    spec_ngram=getattr(self.args, "spec_ngram", 3),
-                    prompt_tokens=prompt_tokens,
-                    topk=topk,
+                elif emitted < max_new and engine.pos < seq_len:
+                    while emitted < max_new and engine.pos < seq_len:
+                        prev = token
+                        logits = engine.decode_step(prev)
+                        token = slot.sampler.sample(logits, pos=engine.pos - 1)
+                        res = feed(prev, token)
+                        if res == EosDetectorResult.EOS:
+                            finish_reason = "stop"
+                            break
+        finally:
+            if ctx is not None:
+                # the whole token loop as one span (the scheduler fans per-row
+                # batch_decode_chunk_row children into the same tree)
+                ctx.add_span(
+                    "decode_stream", decode_t0, time.perf_counter() - decode_t0,
+                    emitted=emitted, finish=finish_reason,
                 )
-        else:
-            # --decode host: the per-token fallback regime — every token
-            # pays a logits fetch + host sort, counted by
-            # dllama_host_sampler_fallback_total; the counter-mode sampler
-            # keys each coin on the consumed position, so the stream is
-            # token-identical to the device path per seed
-            if max_new > 0:
-                token = slot.sampler.sample(logits, pos=engine.pos - 1)
-                res = feed(prompt_tokens[-1], token)
-            if res == EosDetectorResult.EOS:
-                finish_reason = "stop"
-            elif emitted < max_new and engine.pos < seq_len:
-                while emitted < max_new and engine.pos < seq_len:
-                    prev = token
-                    logits = engine.decode_step(prev)
-                    token = slot.sampler.sample(logits, pos=engine.pos - 1)
-                    res = feed(prev, token)
-                    if res == EosDetectorResult.EOS:
-                        finish_reason = "stop"
-                        break
+                ctx.add_stage("decode", stage_sw.elapsed_s())
         if finish_reason == "length":
             # length-limited exit: flush text held back as a possible stop-
             # string prefix (MAYBE_EOS) so the response tail is not lost
@@ -1168,6 +1270,44 @@ def make_handler(state: ApiState):
                 self.end_headers()
                 self.wfile.write(payload)
                 state.tel.requests.labels(route="/metrics", status="200").inc()
+            elif self.path.startswith("/debug/trace/"):
+                # per-request span tree (ISSUE 16): JSON by default,
+                # ?format=chrome for a chrome://tracing / perfetto export.
+                # 404 carries store stats so "why isn't my trace here" is
+                # answerable (not sampled vs never started vs rotated out).
+                rest = self.path[len("/debug/trace/"):]
+                req_id, _, query = rest.partition("?")
+                fmt = "chrome" if "format=chrome" in query else "json"
+                traces = state.traces
+                ctx = traces.get(req_id) if traces is not None else None
+                if ctx is None:
+                    self._send_json(
+                        404,
+                        {
+                            "error": "trace not found",
+                            "request_id": req_id,
+                            "tracing_enabled": traces is not None,
+                            "store": traces.stats() if traces else None,
+                        },
+                    )
+                    state.tel.requests.labels(
+                        route="/debug/trace", status="404"
+                    ).inc()
+                else:
+                    self._send_json(
+                        200,
+                        ctx.chrome_trace() if fmt == "chrome" else ctx.tree(),
+                    )
+                    state.tel.requests.labels(
+                        route="/debug/trace", status="200"
+                    ).inc()
+            elif self.path.rstrip("/") == "/debug/flight":
+                # live flight-recorder view: every replica's lifecycle ring
+                # plus retained auto-dumps (ISSUE 16, OBSERVABILITY.md)
+                self._send_json(200, flight.RECORDER.snapshot())
+                state.tel.requests.labels(
+                    route="/debug/flight", status="200"
+                ).inc()
             else:
                 self.send_error(404)
                 state.tel.requests.labels(route="other", status="404").inc()
@@ -1699,6 +1839,31 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--faults-seed", type=int, default=0,
         help="seed for probabilistic fault rules (p<1)",
+    )
+    # request tracing + flight recorder (ISSUE 16, docs/OBSERVABILITY.md)
+    parser.add_argument(
+        "--trace-sample-rate", type=float, default=1.0,
+        help="fraction of finished request traces RETAINED for "
+        "GET /debug/trace/<id> (every request records while telemetry is "
+        "on; sampling decides retention). Slow requests are always kept — "
+        "see --trace-slow-ttft-s. Requires --telemetry",
+    )
+    parser.add_argument(
+        "--trace-slow-ttft-s", type=float, default=1.0,
+        help="TTFT threshold (seconds) above which a finished trace is "
+        "retained regardless of --trace-sample-rate (the trace you want "
+        "most is the slow one you didn't sample); 0 disables the override",
+    )
+    parser.add_argument(
+        "--trace-retention", type=int, default=256,
+        help="max finished traces retained (bounded deque; oldest rotate "
+        "out first)",
+    )
+    parser.add_argument(
+        "--flight-dump-dir", type=str, default=None,
+        help="directory for flight-recorder JSON artifacts auto-dumped on "
+        "replica death, SDC detection, or a watchdog stall (the in-memory "
+        "dump ring behind GET /debug/flight is always on)",
     )
     # mode is meaningless here but the shared parser requires it
     argv = argv if argv is not None else None

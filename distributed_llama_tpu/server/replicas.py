@@ -52,7 +52,7 @@ import time
 
 from distributed_llama_tpu import retry
 from distributed_llama_tpu.engine import faults, integrity
-from distributed_llama_tpu.telemetry import Stopwatch
+from distributed_llama_tpu.telemetry import Stopwatch, flight
 
 
 class NoPlaceableReplica(faults.ReplicaLost):
@@ -511,9 +511,11 @@ class ReplicaPool:
                     self._canary_golden = result
                     rep.integrity = "ok"
                     rep.canary_fails = 0
+                    flight.record(rep.idx, "canary", verdict="golden_set")
                 elif result == self._canary_golden:
                     rep.integrity = "ok"
                     rep.canary_fails = 0
+                    flight.record(rep.idx, "canary", verdict="ok")
                     if rep.state == SUSPECT:
                         # a full pinned greedy round trip through the real
                         # batched path matching the golden is at least as
@@ -524,6 +526,11 @@ class ReplicaPool:
                     rep.canary_fails += 1
                     self.sdc_mismatches_total += 1
                     self.tel.sdc_mismatches.labels(check="canary").inc()
+                    flight.record(
+                        rep.idx, "canary", verdict="mismatch",
+                        fails=rep.canary_fails,
+                        threshold=self.canary_fail_threshold,
+                    )
                     if rep.canary_fails >= self.canary_fail_threshold:
                         kill_gen = gen
                     elif rep.state == HEALTHY:
@@ -571,6 +578,10 @@ class ReplicaPool:
             self.sdc_mismatches_total += 1
             self.tel.sdc_mismatches.labels(check="shadow").inc()
             for rep in pair:
+                flight.record(
+                    rep.idx, "shadow", verdict="diverged",
+                    pair=[r.idx for r in pair],
+                )
                 if rep.state == HEALTHY:
                     self._set_state_locked(rep, SUSPECT)
             self._cond.notify_all()
@@ -583,6 +594,8 @@ class ReplicaPool:
 
     def _on_event(self, idx: int, generation: int, event: str, value: float) -> None:
         start_restart = False
+        dump_death = False
+        victim_traces: list[str] = []
         with self._cond:
             rep = self.replicas[idx]
             if rep.generation != generation:
@@ -617,7 +630,30 @@ class ReplicaPool:
                     if self.admission is not None:
                         self.admission.resize(-len(rep.slots))
                     start_restart = self.supervise and not self._closed
+                    # flight recorder (ISSUE 16): name the failover's
+                    # victims by their REQUEST traces — the dump links the
+                    # death straight to the /debug/trace/<id> trees of the
+                    # requests it replayed
+                    for s in rep.slots:
+                        t = getattr(getattr(s, "stream", None), "trace", None)
+                        if t is not None:
+                            victim_traces.append(t.request_id)
+                    flight.record(
+                        idx, "failover",
+                        victims=self.last_failover_victims,
+                        victim_trace_ids=victim_traces,
+                        generation=generation,
+                    )
+                    dump_death = True
             self._cond.notify_all()
+        if dump_death:
+            # the auto-dump on replica death — outside the pool cond (the
+            # optional artifact write spawns a thread)
+            flight.RECORDER.dump(
+                idx, "replica_death",
+                victims=self.last_failover_victims,
+                victim_trace_ids=victim_traces,
+            )
         if start_restart:
             threading.Thread(
                 target=self._restart_loop, args=(idx, generation),
@@ -627,6 +663,14 @@ class ReplicaPool:
     def _set_state_locked(self, rep: Replica, state: str) -> None:
         if state == SUSPECT and rep.state != SUSPECT:
             self.suspects_total += 1
+        if state != rep.state:
+            # flight recorder (ISSUE 16): the health-state walk is the
+            # spine of every post-mortem dump. The recorder lock is a
+            # leaf — safe under the pool cond.
+            flight.record(
+                rep.idx, "state", frm=rep.state, to=state,
+                generation=rep.generation,
+            )
         rep.state = state
         self.tel.replica_state.labels(replica=str(rep.idx)).set(
             STATE_VALUES[state]
@@ -720,10 +764,15 @@ class ReplicaPool:
             with self._cond:
                 self.sdc_mismatches_total += 1
             self.tel.sdc_mismatches.labels(check="checksum").inc()
+            flight.record(
+                idx, "checksum", verdict="mismatch", got=got,
+                want=self.weights_reference,
+            )
             raise integrity.ChecksumMismatch(
                 f"replica {idx} rebuild checksum {got} != pool reference "
                 f"{self.weights_reference}; refusing to re-enter placement"
             )
+        flight.record(idx, "checksum", verdict="ok")
 
     # ------------------------------------------------------------------
     # Introspection (/readyz, tests)

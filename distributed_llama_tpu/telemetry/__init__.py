@@ -606,6 +606,33 @@ class ServerInstruments:
             "Wall time of one SDC canary probe (pinned greedy prompt "
             "through the replica's real batched path on a reserved lane)",
         )
+        # request-scoped SLO attribution (ISSUE 16, telemetry/trace.py):
+        # server-side TTFT/TPOT so client p99s decompose without trusting
+        # the client clock, plus the per-stage breakdown the trace tree's
+        # attribution sums are observed from (same timestamps — the
+        # metric surface and /debug/trace can never disagree)
+        self.ttft = histogram(
+            "dllama_ttft_seconds",
+            "Server-side time to first streamed token, by tenant "
+            "(request arrival to the first SSE content delta; replays "
+            "keep the original arrival instant)",
+            labelnames=("tenant",),
+        )
+        self.tpot = histogram(
+            "dllama_tpot_seconds",
+            "Server-side mean time per output token after the first, by "
+            "tenant ((last - first token instant) / (emitted - 1))",
+            labelnames=("tenant",),
+        )
+        self.stage_seconds = histogram(
+            "dllama_request_stage_seconds",
+            "Per-request latency attribution by stage (queue = fair-"
+            "admission wait, placement = replica/lane selection, prefill, "
+            "decode = the streaming loop, replay = all stages of "
+            "requeued re-attempts after a failover/preemption) and "
+            "tenant; sums approximate dllama_http_request_duration_seconds",
+            labelnames=("stage", "tenant"),
+        )
 
 
 class SamplerInstruments:

@@ -2,15 +2,16 @@
 
 Two modes:
 
-* ``--url http://host:port`` — scrape a running server's ``/metrics``
-  endpoint and print the exposition text (or ``--format json`` to parse the
-  in-process snapshot is not possible remotely, so json mode is local-only).
+* ``--url http://host:port`` — scrape a running server. By default the
+  ``/metrics`` exposition text; ``--trace <request_id>`` fetches that
+  request's assembled span tree from ``/debug/trace/<id>`` instead
+  (``--format json`` prints the tree, the default prom format prints the
+  Chrome trace-event export ready for ui.perfetto.dev), and ``--flight``
+  fetches the live flight-recorder snapshot from ``/debug/flight``.
 * no ``--url`` — print THIS process's registry (useful from a REPL or a
   script that imported the engine; a fresh CLI invocation has an empty
-  registry unless ``DLLAMA_TELEMETRY=1`` and something ran).
-
-``--trace PATH`` additionally writes the span ring buffer as Chrome trace
-JSON (local mode only).
+  registry unless ``DLLAMA_TELEMETRY=1`` and something ran). ``--trace``
+  is then a PATH: the ring span buffer is written as Chrome trace JSON.
 """
 
 from __future__ import annotations
@@ -30,11 +31,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--format", choices=["prom", "json"], default="prom",
         help="prom = Prometheus text exposition; json = registry snapshot "
-        "(local mode only)",
+        "(local mode) / raw trace tree (--url --trace)",
     )
     p.add_argument(
-        "--trace", default=None, metavar="PATH",
-        help="also write this process's span buffer as Chrome trace JSON",
+        "--trace", default=None, metavar="ID_OR_PATH",
+        help="with --url: a request id — fetch its span tree from "
+        "/debug/trace/<id> (Chrome trace-event JSON by default, "
+        "--format json for the raw tree). Without --url: a PATH to write "
+        "this process's span buffer as Chrome trace JSON",
+    )
+    p.add_argument(
+        "--flight", action="store_true",
+        help="with --url: fetch the live flight-recorder snapshot from "
+        "/debug/flight (per-replica lifecycle rings + retained dumps)",
     )
     return p
 
@@ -48,19 +57,51 @@ def scrape(url: str, timeout: float = 10.0) -> str:
         return r.read().decode("utf-8", errors="replace")
 
 
+def fetch_json(base: str, path: str, timeout: float = 10.0) -> dict:
+    """GET ``base``+``path`` and parse the JSON body (debug endpoints)."""
+    import urllib.request
+
+    url = base.rstrip("/") + path
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8", errors="replace"))
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from distributed_llama_tpu import telemetry
 
     if args.url:
+        if args.flight:
+            json.dump(
+                fetch_json(args.url, "/debug/flight"), sys.stdout, indent=2
+            )
+            sys.stdout.write("\n")
+            return 0
+        if args.trace:
+            suffix = "" if args.format == "json" else "?format=chrome"
+            try:
+                tree = fetch_json(
+                    args.url, f"/debug/trace/{args.trace}{suffix}"
+                )
+            except Exception as e:
+                sys.stderr.write(
+                    f"trace fetch failed for {args.trace!r}: {e}\n"
+                )
+                return 1
+            json.dump(tree, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+            return 0
         if args.format == "json":
             sys.stderr.write("--format json is local-only; scraping returns exposition text\n")
-        if args.trace:
-            sys.stderr.write(
-                "--trace is local-only (a scrape cannot read the remote span "
-                "buffer); no trace written\n"
-            )
         sys.stdout.write(scrape(args.url))
+        return 0
+    if args.flight:
+        # local mode: this process's recorder (populated only if serving
+        # components ran in-process)
+        from distributed_llama_tpu.telemetry import flight
+
+        json.dump(flight.RECORDER.snapshot(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
         return 0
     if args.format == "json":
         json.dump(telemetry.REGISTRY.snapshot(), sys.stdout, indent=2)

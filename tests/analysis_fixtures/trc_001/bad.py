@@ -1,0 +1,9 @@
+"""TRC-001 bad fixture: a recording call with an unregistered span name
+(the trace surface would grow an unenumerable entry), plus — because
+registry.py is scanned alongside — registered names nothing emits (dead
+entries)."""
+
+
+def hot_path(tel, ctx):
+    with tel.span("span_unknown"):  # TRC-001: not in SPAN_NAMES
+        ctx.add_span("span_known", 0.0, 1.0)

@@ -1,0 +1,10 @@
+"""TRC-001 good fixture: every emitted span name is registered and
+documented, every registered name is emitted — across all three literal
+positions the rule recognizes (first arg, second arg behind a context,
+add_span)."""
+
+
+def hot_path(tel, trace, ctx):
+    with tel.span("span_known"):
+        with trace.span(ctx, "span_other", row=0):
+            ctx.add_span("span_dead", 0.0, 1.0)

@@ -1,0 +1,7 @@
+"""TRC-001 fixture registry (stands in for telemetry/spans.py)."""
+
+SPAN_NAMES = (
+    "span_known",
+    "span_other",
+    "span_dead",
+)

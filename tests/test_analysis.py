@@ -61,6 +61,8 @@ CASES = [
     ("CLK-001", "clk_001", 4, (), {}),
     ("TEL-001", "tel_001", 3, (), {"observability_doc": "doc.md"}),
     ("FLT-001", "flt_001", 3, ("registry.py",), {"fault_registry": "registry.py"}),
+    ("TRC-001", "trc_001", 3, ("registry.py",),
+     {"span_registry": "registry.py", "observability_doc": "doc.md"}),
 ]
 
 
@@ -130,6 +132,84 @@ def test_flt_001_dead_site_check_needs_full_scan():
     cfg = cfg_for("flt_001", fault_registry="registry.py")
     findings, _ = run_rule("FLT-001", fixture("flt_001", "registry.py"), cfg)
     assert findings == []
+
+
+def test_trc_001_reports_unknown_and_dead_names():
+    cfg = cfg_for(
+        "trc_001", span_registry="registry.py", observability_doc="doc.md"
+    )
+    findings, _ = run_rule(
+        "TRC-001", fixture("trc_001", "bad.py", "registry.py"), cfg
+    )
+    unknown = [f for f in findings if "span_unknown" in f.message]
+    dead = [f for f in findings if "dead registry entry" in f.message]
+    assert len(unknown) == 1 and unknown[0].path.endswith("bad.py")
+    assert {f.message.split("`")[1] for f in dead} == {"span_other", "span_dead"}
+    assert all(f.path.endswith("registry.py") for f in dead)
+
+
+def test_trc_001_dead_name_check_needs_full_scan():
+    """Scanning the registry alone cannot prove a span name dead."""
+    cfg = cfg_for(
+        "trc_001", span_registry="registry.py", observability_doc="doc.md"
+    )
+    findings, _ = run_rule("TRC-001", fixture("trc_001", "registry.py"), cfg)
+    assert findings == []
+
+
+def test_trc_001_registered_but_undocumented_name(tmp_path):
+    """A registered span missing from the doc table is its own finding —
+    the doc.md-shared fixture pair can't host this case (good.py must
+    emit every registered name), so it gets real files here."""
+    (tmp_path / "registry.py").write_text('SPAN_NAMES = ("a_span",)\n')
+    (tmp_path / "doc.md").write_text("# spans\n\nnothing backticked here\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text('def f(tel):\n    with tel.span("a_span"):\n        pass\n')
+    cfg = AnalysisConfig(
+        root=str(tmp_path), baseline="",
+        span_registry="registry.py", observability_doc="doc.md",
+    )
+    findings, _ = run_rule("TRC-001", [str(mod)], cfg)
+    assert len(findings) == 1
+    assert "not documented" in findings[0].message
+    # documenting it clears the finding
+    (tmp_path / "doc.md").write_text("| `a_span` | a span |\n")
+    findings2, _ = run_rule("TRC-001", [str(mod)], cfg)
+    assert findings2 == []
+
+
+def test_trc_001_name_literal_in_second_position(tmp_path):
+    """The module helper puts the literal behind the context arg —
+    `trace.span(ctx, "name")` — and the rule must still resolve it."""
+    (tmp_path / "registry.py").write_text('SPAN_NAMES = ("good_one",)\n')
+    (tmp_path / "doc.md").write_text("`good_one`\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "def f(trace, ctx):\n"
+        '    with trace.span(ctx, "bad_one"):\n'
+        '        trace.span(ctx, "good_one")\n'
+    )
+    cfg = AnalysisConfig(
+        root=str(tmp_path), baseline="",
+        span_registry="registry.py", observability_doc="doc.md",
+    )
+    findings, _ = run_rule("TRC-001", [str(mod)], cfg)
+    assert len(findings) == 1 and "bad_one" in findings[0].message
+
+
+def test_span_registry_matches_shipped_names():
+    """SPAN_NAMES and the shipped call sites agree — TRC-001's source of
+    truth enumerates the whole trace surface (mirrors the faults.SITES
+    check below)."""
+    from distributed_llama_tpu.telemetry import spans
+
+    assert len(spans.SPAN_NAMES) == len(set(spans.SPAN_NAMES))
+    for expected in (
+        "queue_wait", "placement", "prefill_chunk", "decode_stream",
+        "batch_decode_chunk_row", "spec_verify_row", "prefix_match",
+        "sse_send",
+    ):
+        assert expected in spans.SPAN_NAMES
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +309,7 @@ def test_repo_config_loads():
     assert cfg.baseline == "analysis-baseline.txt"
     assert "_cond" in cfg.lock_attrs and "_depth_lock" in cfg.lock_attrs
     assert cfg.fault_registry == "distributed_llama_tpu/engine/faults.py"
+    assert cfg.span_registry == "distributed_llama_tpu/telemetry/spans.py"
     assert any("api.py" in entry for entry in cfg.clock_allow)
 
 

@@ -198,6 +198,47 @@ class TestReport:
         d = rep.metric_deltas({}, m, names=("dllama_preemptions_total",))
         assert d == {"dllama_preemptions_total": 1.0}
 
+    def test_client_server_skew_per_tenant(self):
+        # ISSUE 16: client-measured E2E vs the server's stage attribution
+        before = rep.parse_prometheus(
+            'dllama_request_stage_seconds_sum{stage="queue",tenant="a"} 0.5\n'
+        )
+        after = rep.parse_prometheus(
+            'dllama_request_stage_seconds_sum{stage="queue",tenant="a"} 0.6\n'
+            'dllama_request_stage_seconds_sum{stage="decode",tenant="a"} 0.08\n'
+            'dllama_request_stage_seconds_sum{stage="decode",tenant="b"} 0.1\n'
+        )
+        results = [
+            _result(0, tenant="a", e2e=200.0),
+            _result(1, tenant="a", outcome="rejected"),  # not counted
+            _result(2, tenant="b", e2e=100.0),
+        ]
+        skew = rep.client_server_skew(results, before, after)
+        a = skew["a"]
+        assert a["completed"] == 1
+        assert a["client_e2e_s"] == pytest.approx(0.2)
+        assert a["server_attributed_s"] == pytest.approx(0.18)
+        assert a["skew_per_request_ms"] == pytest.approx(20.0)
+        assert skew["b"]["skew_s"] == pytest.approx(0.0)
+
+    def test_expected_flight_gate(self):
+        snap = {"replicas": {
+            "0": [
+                {"kind": "fault_fire", "site": "replica.crash"},
+                {"kind": "failover", "victims": 2},
+            ],
+            "1": [{"kind": "fault_fire", "site": "batch.row"}],
+        }, "dumps": []}
+        ok = rep.check_expected_flight(
+            snap, ["fault_fire:2", "fault_fire@replica.crash", "failover:1"]
+        )
+        assert ok["ok"] and not ok["violations"]
+        bad = rep.check_expected_flight(snap, ["watchdog_stall:1"])
+        assert not bad["ok"] and "watchdog_stall" in bad["violations"][0]
+        # an unreachable /debug/flight is itself a violation
+        gone = rep.check_expected_flight(None, ["failover:1"])
+        assert not gone["ok"]
+
     def test_consistency_flags_diverged_survivors(self):
         ok = rep.check_consistency(
             [_result(0, content="abc"), _result(1, content="abc")]
