@@ -157,11 +157,11 @@ def mixtral_shaped_config(seq_len: int):
 
 def random_q40_params_on_device(cfg):
     """Synthetic Q40 params: random packed nibbles + constant scales, built
-    on device, layers UNSTACKED, in the production INTERLEAVED activation
-    basis (engine/weights.apply_basis_interleave) — random values are their
-    own permutation, so only the layout metadata and the gate_up/down
-    padded-basis shapes need constructing. Kernel throughput does not
-    depend on the values."""
+    on device, layers UNSTACKED, in the STANDARD activation basis — the
+    block-interleaved basis is retired (the int8 MXU scale-product epilogue
+    made the permute moot; basis-era checkpoints are de-interleaved at
+    load by engine/weights.remove_basis_interleave). Kernel throughput
+    does not depend on the values."""
     import jax
     import jax.numpy as jnp
 
@@ -170,36 +170,18 @@ def random_q40_params_on_device(cfg):
         QuantizedMatrix,
         _d_padded,
         _n_padded,
-        interleave_window,
     )
 
     keys = iter(jax.random.split(jax.random.PRNGKey(0), (2 * cfg.n_experts + 8) * cfg.n_layers + 8))
-    # DLT_INTERLEAVE=0 reverts the bench to the standard basis too, so the
-    # jnp.repeat kernel path (still live for wo/MoE/TP/SP/EP) stays
-    # re-measurable against the docs/PERF.md baseline row
-    import os
 
-    interleave_on = os.environ.get("DLT_INTERLEAVE") != "0"
-
-    def qmat(n, d, interleave=False, d_basis: int | None = None, halves: int = 1):
-        # the padding/window rules live in ops.q40 — a local copy desyncing
+    def qmat(n, d):
+        # the padding rules live in ops.q40 — a local copy desyncing
         # would silently route the bench onto the slow XLA fallback
-        interleave = interleave and interleave_on
         n_pad = _n_padded(n)
-        if d_basis is not None and interleave_on:
-            d = d_pad = halves * _n_padded(d_basis)  # interleaved output basis
-        else:
-            # standard basis keeps the real production shapes (trimmed
-            # gate_up output, runtime-padded down input) so a
-            # DLT_INTERLEAVE=0 run reproduces the documented baseline
-            d_pad = _d_padded(d)
+        d_pad = _d_padded(d)
         qs = jax.random.bits(next(keys), (n_pad // 2, d_pad), dtype=jnp.uint8)
         scales = jnp.full((n_pad // 32, d_pad), 1.0 / 256, jnp.float32)
-        W = interleave_window(n_pad) if interleave else None
-        return QuantizedMatrix(
-            qs, scales, n_logical=n, d_logical=d,
-            interleaved=W is not None, packed_bn=0 if W is None else 2 * W,
-        )
+        return QuantizedMatrix(qs, scales, n_logical=n, d_logical=d)
 
     D, F, V, H, K, hd = (
         cfg.dim, cfg.hidden_dim, cfg.vocab_size, cfg.n_heads, cfg.n_kv_heads, cfg.head_size,
@@ -207,24 +189,19 @@ def random_q40_params_on_device(cfg):
 
     def layer():
         lp = {
-            "qkv": qmat(D, (H + 2 * K) * hd, interleave=True),  # fused q|k|v
-            "wo": qmat(H * hd, D, d_basis=D),  # head-basis input: NOT interleaved
+            "qkv": qmat(D, (H + 2 * K) * hd),  # fused q|k|v
+            "wo": qmat(H * hd, D),
             "rms_att": jnp.ones(D, jnp.float32), "rms_ffn": jnp.ones(D, jnp.float32),
         }
         if cfg.is_moe:
             lp["router"] = jax.random.normal(next(keys), (D, cfg.n_experts), jnp.float32) * 0.05
             lp["experts"] = [
-                {
-                    "gate_up": qmat(D, 2 * F, interleave=True, d_basis=F, halves=2),
-                    "down": qmat(_n_padded(F) if interleave_on else F, D,
-                                 interleave=True, d_basis=D),
-                }
+                {"gate_up": qmat(D, 2 * F), "down": qmat(F, D)}
                 for _ in range(cfg.n_experts)
             ]
         else:
-            lp["gate_up"] = qmat(D, 2 * F, interleave=True, d_basis=F, halves=2)
-            lp["down"] = qmat(_n_padded(F) if interleave_on else F, D,
-                              interleave=True, d_basis=D)
+            lp["gate_up"] = qmat(D, 2 * F)
+            lp["down"] = qmat(F, D)
         return lp
 
     layers = [layer() for _ in range(cfg.n_layers)]
@@ -232,7 +209,7 @@ def random_q40_params_on_device(cfg):
         "embedding": jax.random.normal(next(keys), (V, D), jnp.float32) * 0.02,
         "layers": layers,
         "rms_final": jnp.ones(D, jnp.float32),
-        "wcls": qmat(D, V, interleave=True),
+        "wcls": qmat(D, V),
         "rope_table": jnp.asarray(build_rope_table(cfg)),
     }
 
@@ -1600,15 +1577,17 @@ def run_pod(data: int = 2, model: int = 2, parallel: int = 4,
 
 
 def run_kernels() -> dict:
-    """``bench.py --kernels``: the ISSUE 14 Pallas-kernel A/B gate as one
-    committed JSON — each kernel measured against the path it replaces IN
-    THE SAME PROCESS with parity asserted, plus the computed roofline
-    fields for the matmul arms. On a CPU host the kernels run in Pallas
-    interpret mode: the timings are mechanism-relative (interpret has
-    per-op overhead the chip doesn't), the PARITY gates are authoritative,
-    and the roofline fractions are denominated against the v5e peak so the
-    TPU rerun drops into the same fields (chip numbers pending, the
-    BENCH_r0x convention)."""
+    """``bench.py --kernels``: the Pallas-kernel A/B gate (ISSUE 14, grown
+    by the ISSUE 17 decode-superstep fusions) as one committed JSON — each
+    kernel measured against the path it replaces IN THE SAME PROCESS with
+    parity asserted, plus the computed roofline fields for the matmul arms
+    and the fused-vs-unfused per-layer program-dispatch count. On a CPU
+    host the kernels run in Pallas interpret mode: the timings are
+    mechanism-relative (interpret has per-op overhead the chip doesn't),
+    the PARITY gates and dispatch counts are authoritative, and the
+    roofline fractions are denominated against the v5e peak so the TPU
+    rerun drops into the same fields (chip numbers pending, the BENCH_r0x
+    convention)."""
     import functools
 
     import jax
@@ -1617,7 +1596,13 @@ def run_kernels() -> dict:
     from distributed_llama_tpu.models.sampling import _pick_sorted, _topp_partition_pick
     from distributed_llama_tpu.ops import attention as att
     from distributed_llama_tpu.ops import collectives
-    from distributed_llama_tpu.ops.q40 import dequantize_tpu, q40_matmul, quantize_q40_tpu
+    from distributed_llama_tpu.ops.q40 import (
+        dequantize_tpu,
+        q40_matmul,
+        quantize_q40_tpu,
+        rmsnorm_q40_matmul,
+        rmsnorm_ref,
+    )
 
     rng = np.random.RandomState(0)
     detail: dict = {"device": str(jax.devices()[0])}
@@ -1654,7 +1639,33 @@ def run_kernels() -> dict:
         **arms,
         "int8_vs_f32_speedup": round(
             bench_metric("kernels_q40_int8_vs_f32", arms["f32"]["ms"] / arms["int8"]["ms"]), 3),
-        "shape": f"[{T},{n}]x[{n},{d}] q40, interleave off, interpret on CPU",
+        "shape": f"[{T},{n}]x[{n},{d}] q40, standard basis, interpret on CPU",
+    }
+
+    # ---- fused rmsnorm→Q80 epilogue vs the standalone chain (ISSUE 17) --
+    # the 7B layer shape again: the fusion deletes the separate rmsnorm
+    # program ahead of every decode matmul (T=1), bit-identically
+    wgt = jnp.asarray(rng.rand(n).astype(np.float32) + 0.5)
+
+    def fused_norm():
+        return rmsnorm_q40_matmul(x, wgt, qm, path="int8")
+
+    def standalone_norm():
+        return q40_matmul(rmsnorm_ref(x, wgt).astype(jnp.bfloat16), qm, path="int8")
+
+    assert np.array_equal(
+        np.asarray(fused_norm()), np.asarray(standalone_norm())
+    ), "fused rmsnorm epilogue broke bit-parity"
+    ms_fn, ms_sn = timed(fused_norm), timed(standalone_norm)
+    detail["rmsnorm_fusion"] = {
+        "standalone_ms": round(ms_sn, 2),
+        "fused_ms": round(ms_fn, 2),
+        "fused_vs_standalone_speedup": round(
+            bench_metric("kernels_fusedq_vs_standalone", ms_sn / ms_fn), 3),
+        "bit_identical": True,
+        **roofline_detail(q40_bytes, 1000.0 / ms_sn, prefix="standalone_"),
+        **roofline_detail(q40_bytes, 1000.0 / ms_fn, prefix="fusedq_"),
+        "shape": f"rmsnorm+[{T},{n}]x[{n},{d}] q40 int8, interpret on CPU",
     }
 
     # ---- fused paged decode-attention vs the segmented-scan chain --------
@@ -1696,6 +1707,69 @@ def run_kernels() -> dict:
         "shape": f"B={B} S={S} chunk={chunk} page={page} f32, interpret on CPU",
     }
 
+    # ---- double-buffered vs serial page-DMA schedule (tentpole c) -------
+    def db_arm():
+        return att.fused_paged_decode_attention(
+            qg, keys, values, pos, chunk, paged, double_buffer=True)
+
+    def serial_arm():
+        return att.fused_paged_decode_attention(
+            qg, keys, values, pos, chunk, paged, double_buffer=False)
+
+    assert bool(jnp.all(db_arm() == serial_arm())), "DMA schedule changed bytes"
+    ms_db, ms_serial = timed(jax.jit(db_arm)), timed(jax.jit(serial_arm))
+    detail["paged_dma_overlap"] = {
+        "serial_ms": round(ms_serial, 2),
+        "double_buffered_ms": round(ms_db, 2),
+        "bit_identical": True,
+        "note": "interpret mode runs DMAs synchronously, so the CPU A/B "
+        "pins bytes + dispatch overhead only; the chunk i+1 loads-under-"
+        "compute overlap shows on chip",
+    }
+
+    # ---- spec-verify fused kernel vs the segmented verify scan (d) ------
+    Tv = 4
+    qgv = jnp.asarray(rng.randn(B, Tv, K, M, hd).astype(np.float32))
+    posv = jnp.maximum(matched, pos - Tv)  # verify windows sit past matched
+
+    def verify_scan():
+        prev = os.environ.get("DLT_FUSED_PAGED")
+        os.environ["DLT_FUSED_PAGED"] = "0"
+        try:
+            return att.batched_verify_attention(
+                qgv, keys, values, posv, chunk, paged=paged)
+        finally:
+            if prev is None:
+                os.environ.pop("DLT_FUSED_PAGED", None)
+            else:
+                os.environ["DLT_FUSED_PAGED"] = prev
+
+    def verify_fused():
+        return att.fused_paged_verify_attention(qgv, keys, values, posv, chunk, paged)
+
+    # the two DMA schedules are bit-identical by construction; the XLA
+    # scan's fori_loop codegen can reassociate the merge by ulps at T>1
+    # (the mechanism _segmented_batched_scan documents), so the scan arm
+    # is pinned to within-ulp with the divergence recorded
+    v_fused = np.asarray(verify_fused())
+    v_serial = np.asarray(att.fused_paged_verify_attention(
+        qgv, keys, values, posv, chunk, paged, double_buffer=False))
+    assert np.array_equal(v_fused, v_serial), "verify DMA schedule changed bytes"
+    v_scan = np.asarray(verify_scan())
+    v_div = float(np.abs(v_scan - v_fused).max())
+    assert v_div < 1e-6, f"fused verify drifted from the scan: {v_div}"
+    ms_vscan, ms_vfused = timed(jax.jit(verify_scan)), timed(jax.jit(verify_fused))
+    detail["spec_verify_attention"] = {
+        "segmented_scan_ms": round(ms_vscan, 2),
+        "fused_kernel_ms": round(ms_vfused, 2),
+        "fused_vs_scan_speedup": round(
+            bench_metric("kernels_fused_verify_vs_scan", ms_vscan / ms_vfused), 3),
+        "dma_schedules_bit_identical": True,
+        "max_abs_divergence_vs_scan": v_div,
+        "shape": f"B={B} T={Tv} S={S} chunk={chunk} page={page} f32, "
+        "interpret on CPU",
+    }
+
     # ---- ring all-reduce vs psum on the mesh ----------------------------
     from jax.experimental import mesh_utils
     from jax.sharding import Mesh, PartitionSpec as P
@@ -1724,6 +1798,110 @@ def run_kernels() -> dict:
         "note": "ring_xla = the ring schedule in XLA ppermute steps (the "
         "CPU-mesh realization); the pallas remote-DMA ring compiles on "
         "TPU only — its schedule is pinned by this parity",
+    }
+
+    # ---- matmul+all-reduce seam: overlapped vs sequential (tentpole b) --
+    # the wo shard shape of the 7B layer: each device holds 4096/n_dev rows
+    # of the q40 pack; the seam either composes matmul→all_reduce or (on
+    # TPU, int8 path) runs the fused ring epilogue. CPU pins the arms.
+    n_sh = 4096 // n_dev
+    packs = [
+        quantize_q40_tpu(rng.randn(n_sh, 4096).astype(np.float32) / 64.0)
+        for _ in range(n_dev)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *packs)
+    xs_sh = jnp.asarray(rng.randn(n_dev, 1, n_sh).astype(np.float32))
+
+    def seam(impl):
+        def f(xsh, qm_):
+            qm0 = jax.tree.map(lambda a: a[0], qm_)
+            return collectives.matmul_all_reduce(xsh[0], qm0, "tp", impl=impl)
+        return jax.jit(shard_map_compat(
+            f, mesh=mesh, in_specs=(P("tp"), P("tp")), out_specs=P(None, None)))
+
+    seam_psum, seam_ring = seam("psum"), seam("ring_xla")
+    out_psum = np.asarray(seam_psum(xs_sh, stacked))
+    out_ring = np.asarray(seam_ring(xs_sh, stacked))
+    sc = np.abs(out_psum).max()
+    np.testing.assert_allclose(out_ring / sc, out_psum / sc, atol=1e-5)
+    ms_seq = timed(lambda: seam_psum(xs_sh, stacked))
+    ms_ovl = timed(lambda: seam_ring(xs_sh, stacked))
+    detail["matmul_allreduce_seam"] = {
+        "sequential_psum_ms": round(ms_seq, 2),
+        "ring_schedule_ms": round(ms_ovl, 2),
+        "max_rel_divergence": round(float(np.abs(out_ring - out_psum).max() / sc), 8),
+        "devices": n_dev,
+        "shape": f"[1,{n_sh}]x[{n_sh},4096] q40 per shard",
+        "note": "arms agree within f32 summation-order tolerance; the fused "
+        "remote-DMA epilogue (fused_ring) is TPU-compiled only and falls "
+        "back to this composition elsewhere — its tile accumulation order "
+        "is pinned bit-exact vs the unfused int8 matmul per chunk",
+    }
+
+    # ---- superstep program dispatches: fused vs unfused (acceptance) ----
+    # one decode layer at the 7B shape, counted via dllama_kernel_path_total
+    # — the counter notes one label per dispatch decision, so with the
+    # segmented scan weighted by its 3 segment programs (pool/mixed/slab)
+    # the sum IS the per-layer program count.
+    def superstep():
+        h = rmsnorm_q40_matmul(x, wgt, qm, path="int8")       # attn norm+qkv
+        a_ = att.batched_decode_attention(qg, keys, values, pos, chunk, paged=paged)
+        o = q40_matmul(x, qm, path="int8")                    # wo
+        g = rmsnorm_q40_matmul(x, wgt, qm, path="int8")       # ffn norm+gate_up
+        dn = q40_matmul(x, qm, path="int8")                   # down
+        return h, a_, o, g, dn
+
+    _LABELS = {
+        "q40_matmul": ("mxu_int8", "mxu_int8_fusedq", "vpu_f32", "xla_fallback"),
+        "paged_attention": ("pallas_fused", "pallas_fused_verify", "xla_segmented"),
+        "all_reduce": ("ici_ring", "fused_ring", "ring_xla", "psum"),
+        "rmsnorm": ("xla_standalone",),
+    }
+    _WEIGHT = {"xla_segmented": 3}  # pool/mixed/slab segment programs
+
+    def count_dispatches(env: dict) -> tuple[int, dict]:
+        prev = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.enable()
+        try:
+            telemetry.reset()
+            for out in superstep():
+                np.asarray(out)
+            ctr = telemetry.REGISTRY.counter(
+                "dllama_kernel_path_total", labelnames=("kernel", "path"))
+            programs = {}
+            for kern, paths in _LABELS.items():
+                for p in paths:
+                    v = int(ctr.labels(kernel=kern, path=p).value)
+                    if v:
+                        programs[f"{kern}/{p}"] = v * _WEIGHT.get(p, 1)
+            return sum(programs.values()), programs
+        finally:
+            telemetry.reset()
+            telemetry.disable()
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    fused_n, fused_programs = count_dispatches({})
+    unfused_n, unfused_programs = count_dispatches(
+        {"DLT_FUSED_Q80": "0", "DLT_FUSED_PAGED": "0"})
+    assert fused_n < unfused_n, (
+        f"fused superstep must strictly reduce dispatches: {fused_n} vs {unfused_n}"
+    )
+    detail["superstep_dispatches"] = {
+        "fused_programs_per_layer": fused_n,
+        "unfused_programs_per_layer": unfused_n,
+        "reduction": round(
+            bench_metric("kernels_superstep_dispatch_reduction",
+                         unfused_n / fused_n), 3),
+        "fused_breakdown": fused_programs,
+        "unfused_breakdown": unfused_programs,
+        "note": "counted via dllama_kernel_path_total over one decode layer "
+        "(qkv, attention, wo, gate_up, down) at the 7B shape; xla_segmented "
+        "weighted 3 for its pool/mixed/slab segment programs",
     }
 
     # ---- partition-based bare-top-p vs the full-vocab sort ---------------
@@ -1948,10 +2126,13 @@ if __name__ == "__main__":
         # — committed as BENCH_POD_*.json
         print(json.dumps(run_pod()))
     elif "--kernels" in sys.argv:
-        # Pallas kernel A/B gates (ISSUE 14): int8-MXU vs f32 q40 kernel,
-        # fused paged attention vs the segmented scan (bit-parity
-        # asserted), ring all-reduce vs psum, partition top-p vs full
-        # sort — committed as BENCH_KERNELS_*.json
+        # Pallas kernel A/B gates (ISSUE 14 + the ISSUE 17 superstep
+        # fusions): int8-MXU vs f32 q40 kernel, fused rmsnorm→Q80 epilogue
+        # vs standalone chain, fused paged attention vs the segmented scan
+        # (decode AND spec-verify, bit-parity asserted), double-buffered vs
+        # serial page DMAs, matmul+all-reduce seam arms, partition top-p vs
+        # full sort, and the fused-vs-unfused superstep program-dispatch
+        # count — committed as BENCH_KERNELS_*.json
         print(json.dumps(run_kernels()))
     elif "--mixtral-only" in sys.argv:
         # multi-model probe (BASELINE config 3's shape class): one-chip

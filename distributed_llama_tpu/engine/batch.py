@@ -1308,6 +1308,31 @@ class BatchScheduler:
                     self.engine._tel.rows_quarantined.inc()
         return [s for s in joined if s._fetch_error is None]
 
+    def _fire_fused_step_locked(self, joined):
+        """The ``engine.fused_step`` fault site (ISSUE 17 chaos contract):
+        fired per joined row while a batched chunk — plain decode OR spec
+        verify — is about to launch the fused per-layer superstep programs
+        (rmsnorm→Q80→matmul epilogue, fused paged attention, the
+        matmul+all-reduce seam). A row-targeted raise mid-superstep
+        quarantines ONLY the victim, releases any page pins it holds, and
+        drops it from the dispatch; the survivors' streams must be
+        bit-identical to a fault-free run — one row's fused program
+        failing must never corrupt the shared dispatch."""
+        for s in joined:
+            try:
+                self._faults.fire("engine.fused_step", row=s.row)
+            except Exception as e:
+                err = faults.RowQuarantined(
+                    "batch row retired: fused superstep dispatch failed "
+                    "for this row"
+                )
+                err.__cause__ = e
+                s._fetch_error = err
+                if self._pool is not None:
+                    self._release_pins_locked(s)
+                self.engine._tel.rows_quarantined.inc()
+        return [s for s in joined if s._fetch_error is None]
+
     def _alias_arrays_locked(self, rows, live_flags):
         """Per-dispatch page tables [len(rows), n_table] + matched lengths
         (cond held; ``live_flags`` is :meth:`_row_dispatch_arrays_locked`'s
@@ -1706,6 +1731,7 @@ class BatchScheduler:
         if not joined:
             return
         joined = self._fire_paged_attn_locked(joined)
+        joined = self._fire_fused_step_locked(joined)
         if not joined:
             self._cond.notify_all()
             return
@@ -1805,6 +1831,7 @@ class BatchScheduler:
         if not joined:
             return
         joined = self._fire_paged_attn_locked(joined)
+        joined = self._fire_fused_step_locked(joined)
         if not joined:
             self._cond.notify_all()
             return

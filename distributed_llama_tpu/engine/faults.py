@@ -35,6 +35,14 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     a ``row=`` rule quarantines ONLY the targeted row AND
                     releases its page pins (the aliased pages stay live
                     for every other row; survivors bit-identical)
+``engine.fused_step``  raise mid-superstep (ISSUE 17): fired per joined
+                    row as a batched chunk — plain decode or spec verify —
+                    is about to launch the fused per-layer programs
+                    (rmsnorm→Q80→matmul epilogue, fused paged attention,
+                    the matmul+all-reduce seam). A ``row=`` rule
+                    quarantines ONLY the victim and releases its page
+                    pins; co-batched survivors stream bit-identically
+                    (engine/batch.py ``_fire_fused_step_locked``)
 ``engine.sdc``      silent-data-corruption injection (ISSUE 10): a
                     ``kind=corrupt`` rule fired per batched-chunk dispatch
                     deterministically perturbs this replica's state into
@@ -204,6 +212,7 @@ SITES = (
     "engine.fetch",
     "engine.spec_verify",
     "engine.paged_attn",
+    "engine.fused_step",
     "engine.preempt",
     "engine.sdc",
     "engine.spill",

@@ -52,10 +52,13 @@ ATT_BLOCK_PREFILL_S = 4096  # blocked attention for T>8 from this seq_len up
 
 def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
     """y = w * x / sqrt(mean(x^2) + eps), computed in f32
-    (reference: src/funcs.cpp:95-146 — note eps is added to the mean square)."""
-    xf = x.astype(jnp.float32)
-    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (weight.astype(jnp.float32) * (xf * jax.lax.rsqrt(ms + eps))).astype(x.dtype)
+    (reference: src/funcs.cpp:95-146 — note eps is added to the mean square).
+    Delegates to ``ops.q40.rmsnorm_ref`` — the ONE rmsnorm definition, so
+    the fused rmsnorm→Q80→matmul entry (:func:`_norm_matmul`) is
+    bit-identical to this by construction."""
+    from distributed_llama_tpu.ops.q40 import rmsnorm_ref
+
+    return rmsnorm_ref(x, weight, eps)
 
 
 def _activation(x: jax.Array, act: HiddenAct) -> jax.Array:
@@ -85,6 +88,20 @@ def _matmul(x: jax.Array, w) -> jax.Array:
     )
 
 
+def _norm_matmul(x: jax.Array, weight: jax.Array, w) -> jax.Array:
+    """rmsnorm(x, weight) @ w — ONE fused program on the q40 int8 path
+    (the decode superstep's part (a): the Q80 activation quantize rides
+    the rmsnorm epilogue instead of paying its own program dispatch,
+    ``ops.q40.rmsnorm_q40_matmul``); the unfused reference sequence for
+    plain-array weights. Bit-identical either way (the fused entry inlines
+    ``rmsnorm_ref``'s exact ops — test-enforced)."""
+    from distributed_llama_tpu.ops.q40 import QuantizedMatrix, rmsnorm_q40_matmul
+
+    if isinstance(w, QuantizedMatrix):
+        return rmsnorm_q40_matmul(x, weight, w)
+    return _matmul(rmsnorm(x, weight).astype(w.dtype), w)
+
+
 def project_qkv(
     cfg: LlamaConfig,
     lp: Params,
@@ -97,19 +114,21 @@ def project_qkv(
     llamaRmsAtt/llamaQkv/llamaRope chain, src/llama2-tasks.cpp:10-52)."""
     T = x.shape[0]
     hd = cfg.head_size
-    xn = rmsnorm(x, lp["rms_att"])
     if "qkv" in lp:
         # q|k|v packed as one matmul on the output dim (the q40 path: one
-        # large bandwidth-efficient kernel call instead of three small ones)
-        xc = xn.astype(lp["qkv"].dtype)
-        fused = _matmul(xc, lp["qkv"])  # [T, (Hl+2*Kl)*hd] f32
+        # large bandwidth-efficient kernel call instead of three small
+        # ones) — and the norm + Q80 quantize fused into that same program
+        # on the int8 path (_norm_matmul)
+        fused = _norm_matmul(x, lp["rms_att"], lp["qkv"])  # [T, (Hl+2*Kl)*hd] f32
         d_q = lp["wo"].shape[-2]  # Hl*hd (wo's input dim)
         d_kv = (fused.shape[-1] - d_q) // 2
         q = fused[:, :d_q]
         k = fused[:, d_q : d_q + d_kv]
         v = fused[:, d_q + d_kv :]
     else:
-        xc = xn.astype(lp["q"].dtype)
+        # three consumers of one normed activation: the norm cannot ride a
+        # single matmul's epilogue here, so it stays standalone
+        xc = rmsnorm(x, lp["rms_att"]).astype(lp["q"].dtype)
         q = _matmul(xc, lp["q"])  # [T, Hl*hd] f32
         k = _matmul(xc, lp["k"])  # [T, Kl*hd]
         v = _matmul(xc, lp["v"])  # [T, Kl*hd]
@@ -136,17 +155,21 @@ def block_tail(
     exchange (parallel.expert_parallel). ``n_real``: number of REAL rows in
     a bucket-padded batch (rows >= n_real are engine pad zeros) — the
     capacity-bucketed MoE prefill masks pads out of its expert buckets."""
-    out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
-    if axis_name is not None:
+    if axis_name is None:
+        out = _matmul(att.astype(lp["wo"].dtype), lp["wo"])  # [T, dim]
+    else:
         # the TP all-reduce: replaces gather + merge-add on root
-        # (reference: src/llama2-tasks.cpp:115-131) with one ICI collective.
-        # Routed through the all-reduce seam (ops.collectives): psum by
-        # default off-TPU, the bidirectional ring kernel on TPU — the ring
-        # overlaps the reduce with the matmul epilogue instead of fencing
-        # behind it
+        # (reference: src/llama2-tasks.cpp:115-131) with one ICI collective,
+        # routed through the matmul+all-reduce seam (ops.collectives): the
+        # unfused matmul + psum/ring_xla arms off-TPU, and under
+        # DLT_ALLREDUCE=ring the fused int8+ring kernel whose per-chunk
+        # epilogue starts the reduce-scatter DMAs while the next chunk's
+        # MXU work is in flight (decode superstep, part b)
         from distributed_llama_tpu.ops import collectives
 
-        out = collectives.all_reduce(out, axis_name)
+        out = collectives.matmul_all_reduce(
+            att.astype(lp["wo"].dtype), lp["wo"], axis_name
+        )
     if cfg.arch.name == "GROK1":
         # grok rmsnorms the attention output with rmsFfn before the residual
         # add (reference: src/grok1-tasks.cpp:16-41)
@@ -164,9 +187,10 @@ def block_tail(
 
 def final_logits(cfg: LlamaConfig, params: Params, x: jax.Array) -> jax.Array:
     """Final rmsnorm + logits head (+Grok's logit scale),
-    reference: src/llama2-tasks.cpp:222-239, src/grok1-tasks.cpp:270-273."""
-    x = rmsnorm(x, params["rms_final"])
-    logits = _matmul(x.astype(params["wcls"].dtype), params["wcls"])
+    reference: src/llama2-tasks.cpp:222-239, src/grok1-tasks.cpp:270-273.
+    Norm + quantize + matmul fuse into one program on the q40 int8 path
+    (_norm_matmul)."""
+    logits = _norm_matmul(x, params["rms_final"], params["wcls"])
     if cfg.arch.name == "GROK1":
         logits = logits * 0.5773502691896257
     return logits
@@ -285,20 +309,22 @@ def attention(
 def ffn(cfg: LlamaConfig, x: jax.Array, lp: Params, axis_name: str | None) -> jax.Array:
     """SwiGLU FFN (reference: src/llama2-tasks.cpp:158-212)."""
     if "gate_up" in lp:
-        # gate|up packed as one matmul (see the qkv note in attention)
-        xn = rmsnorm(x, lp["rms_ffn"]).astype(lp["gate_up"].dtype)
-        fused = _matmul(xn, lp["gate_up"])
+        # gate|up packed as one matmul (see the qkv note in attention),
+        # with the norm + Q80 quantize fused in on the int8 path
+        fused = _norm_matmul(x, lp["rms_ffn"], lp["gate_up"])
         hidden = fused.shape[-1] // 2
         h = _activation(fused[:, :hidden], cfg.hidden_act) * fused[:, hidden:]
     else:
         xn = rmsnorm(x, lp["rms_ffn"]).astype(lp["gate"].dtype)
         h = _activation(_matmul(xn, lp["gate"]), cfg.hidden_act) * _matmul(xn, lp["up"])
-    out = _matmul(h.astype(lp["down"].dtype), lp["down"])
-    if axis_name is not None:
-        from distributed_llama_tpu.ops import collectives
+    if axis_name is None:
+        return _matmul(h.astype(lp["down"].dtype), lp["down"])
+    from distributed_llama_tpu.ops import collectives
 
-        out = collectives.all_reduce(out, axis_name)
-    return out
+    # down + TP all-reduce through the fused seam (see block_tail)
+    return collectives.matmul_all_reduce(
+        h.astype(lp["down"].dtype), lp["down"], axis_name
+    )
 
 
 def block_forward(

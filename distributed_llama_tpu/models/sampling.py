@@ -793,7 +793,11 @@ def spec_verify_chunk_batched_paged(
     """:func:`spec_verify_chunk_batched` with zero-copy prefix aliasing:
     verify windows attend over pool pages for the matched prefix and the
     slab row for the private suffix, bit-identical to the copied-prefix
-    verify (the spec × prefix-cache parity contract)."""
+    verify (the spec × prefix-cache parity contract). The paged verify
+    attention rides the fused Pallas kernel
+    (``ops.attention.fused_paged_verify_attention`` — one program per
+    layer instead of the segmented-scan chain) under the same
+    ``DLT_FUSED_PAGED`` gate and bit-parity pins as the decode hit path."""
     logits, cache = llama.forward_verify_batched(
         cfg, params, feed, cache, pos, active, paged=(pool, tables, matched)
     )

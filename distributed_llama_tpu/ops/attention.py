@@ -206,6 +206,34 @@ def _decode_partial(qg, pos, chunk: int, cdt, prec):
     return partial
 
 
+def _verify_partial(qg, pos, chunk: int, cdt, prec):
+    """The per-chunk online-softmax arithmetic of the batched verify scan
+    (speculative decode: T-query windows at pos[b]..pos[b]+T-1) — ONE
+    definition consumed by both the XLA segmented scan and the fused Pallas
+    kernel body, exactly like :func:`_decode_partial`: identical op
+    sequence on identical chunk bytes is the bit-parity mechanism."""
+    B, T, K, M, hd = qg.shape
+    q_pos = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+
+    def partial(kc, vc, start, carry):
+        m, l, o = carry
+        k_pos = start + jnp.arange(chunk)
+        scores = kvc.scores_einsum_verify(qg.astype(cdt), kc, prec) / jnp.sqrt(
+            jnp.float32(hd)
+        )  # [B, T, K, M, chunk]
+        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, :, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        ms = jnp.max(scores, axis=-1)
+        safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(mask, p, 0.0)
+        ls = jnp.sum(p, axis=-1)
+        os_ = kvc.mix_einsum_verify(p, vc, cdt, prec)
+        return merge_partials(m, l, o, ms, ls, os_)
+
+    return partial
+
+
 def batched_decode_attention(
     qg: jax.Array,  # [B, K, M, hd] f32 grouped queries (one token per row)
     keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
@@ -273,17 +301,26 @@ def batched_decode_attention(
 # SHARED per-chunk arithmetic (:func:`_decode_partial`) with the online-
 # softmax carries resident on-chip — so the merge math is the identical op
 # sequence on identical bytes and the output is BIT-IDENTICAL to the
-# segmented scan's (the EXACT-EMPTY-PARTIAL semantics ride along for free;
-# test-enforced across bf16/f32/i8 and bucket shapes in
-# tests/test_kernel_parity.py).
+# eager composition of those per-chunk partials (the EXACT-EMPTY-PARTIAL
+# semantics ride along for free; test-enforced across bf16/f32/i8 and
+# bucket shapes in tests/test_kernel_parity.py). The XLA scan is the same
+# math but its fori_loop codegen may reassociate the merge by ulps at
+# verify widths T>1 (the mechanism _segmented_batched_scan documents) —
+# parity vs the scan is bit-exact at the pinned decode/verify test shapes
+# and within-ulp in general (bench.py --kernels records the divergence).
 #
 # Compiled-mode notes: operands sit in ANY (HBM) memory space, chunks are
 # DMA'd into VMEM scratch, page tables/ids read from SMEM — the Mosaic-
-# shaped structure. The DMAs are issued serially (start+wait per copy);
-# double-buffering the next chunk's loads behind the current chunk's
-# einsums is the named headroom (docs/PERF.md). The authoritative gate in
-# this tree is interpret-mode bit-parity on the CPU mesh — the container's
-# jax cannot compile Mosaic.
+# shaped structure. The page/slab DMAs are DOUBLE-BUFFERED: chunk i+1's
+# copies start into the other scratch slot before chunk i's einsums run, so
+# the loads fly under the compute (``DLT_FUSED_DB=0`` keeps the serial
+# start+wait schedule — the A/B baseline in bench.py --kernels; the
+# schedule only reorders copy issue around unchanged compute, so both arms
+# are bit-identical by construction). The same kernel body serves the
+# speculative-decode verify hit path (T-query windows per row — decode is
+# its T=1 degenerate case; :func:`fused_paged_verify_attention`). The
+# authoritative gate in this tree is interpret-mode bit-parity on the CPU
+# mesh — the container's jax cannot compile Mosaic.
 # ---------------------------------------------------------------------------
 
 
@@ -320,22 +357,34 @@ def _fused_paged_eligible(qg, keys, values, paged, chunk: int) -> bool:
     return chunk % page == 0 and S % chunk == 0
 
 
-def fused_paged_decode_attention(
-    qg: jax.Array,  # [B, K, M, hd] f32 grouped queries (one token per row)
-    keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
-    values,
-    pos: jax.Array,  # [B] per-row absolute positions
-    chunk: int,
-    paged,  # (pool_k, pool_v, tables [B, n_table], matched [B])
-    interpret: bool | None = None,
-) -> jax.Array:
-    """The fused Pallas form of the paged :func:`batched_decode_attention`
-    hit path — same segment split, same chunk order, same merge arithmetic,
-    bit-identical output. Returns [B, K, M, hd] f32."""
+def _double_buffer_default() -> bool:
+    """``DLT_FUSED_DB`` gates the double-buffered DMA schedule (default ON:
+    the schedule only reorders copy issue/wait around unchanged compute, so
+    both arms produce identical bytes by construction — pinned by the A/B
+    arm in bench.py --kernels and tests/test_kernel_parity.py).
+    ``DLT_FUSED_DB=0`` keeps the serial start+wait schedule. Read per
+    dispatch decision (trace time)."""
+    env = _os.environ.get("DLT_FUSED_DB")
+    return env != "0" if env is not None else True
+
+
+def _fused_paged_attention(
+    qg, keys, values, pos, chunk: int, paged, interpret, double_buffer, verify: bool
+):
+    """Shared builder behind :func:`fused_paged_decode_attention` and
+    :func:`fused_paged_verify_attention` — decode is the T=1 degenerate
+    case of the verify window, so ONE kernel body serves both and a parity
+    fix can never reach one entry point and skip the other."""
     from distributed_llama_tpu.ops.q40 import tpu_compiler_params
 
     pool_k, pool_v, tables, matched = paged
-    B, K, M, hd = qg.shape
+    if verify:
+        B, T, K, M, hd = qg.shape
+        lead = (B, T, K, M)
+    else:
+        B, K, M, hd = qg.shape
+        T = 1  # decode: one query per row, live bound max(pos) + 1
+        lead = (B, K, M)
     S = keys.shape[1]
     quant = isinstance(keys, kvc.QuantizedKV)
     page = kvc.pool_page_size(pool_k)
@@ -346,18 +395,22 @@ def fused_paged_decode_attention(
     prec = kvc.einsum_precision(keys)
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
+    if double_buffer is None:
+        double_buffer = _double_buffer_default()
+    nslots = 2 if double_buffer else 1
 
     def halves(h):
         return (h.data, h.scales) if quant else (h,)
 
     def scratch_for(h, n_rows: int):
-        """VMEM chunk-scratch shapes mirroring one source's halves."""
+        """VMEM chunk-scratch shapes mirroring one source's halves — one
+        buffer per DMA slot (two double-buffered, one serial)."""
         if quant:
             return [
-                pltpu.VMEM((n_rows, chunk, K, hd), h.data.dtype),
-                pltpu.VMEM((n_rows, chunk, K, 1), h.scales.dtype),
+                pltpu.VMEM((nslots, n_rows, chunk, K, hd), h.data.dtype),
+                pltpu.VMEM((nslots, n_rows, chunk, K, 1), h.scales.dtype),
             ]
-        return [pltpu.VMEM((n_rows, chunk, K, hd), h.dtype)]
+        return [pltpu.VMEM((nslots, n_rows, chunk, K, hd), h.dtype)]
 
     def kernel(*refs):
         pos_ref, matched_ref, tables_ref, qg_ref = refs[:4]
@@ -370,67 +423,125 @@ def fused_paged_decode_attention(
         pk_scr, pv_scr = scr[2 * nh : 3 * nh], scr[3 * nh : 4 * nh]
         sem = scr[4 * nh]
 
-        def copy(src, dst):
-            c = pltpu.make_async_copy(src, dst, sem)
-            c.start()
-            c.wait()
+        pos_ = pos_ref[:]
+        matched_ = matched_ref[:]
+        mk_partial = _verify_partial if verify else _decode_partial
+        partial = mk_partial(qg_ref[:], pos_, chunk, cdt, prec)
+        live = jnp.clip(jnp.max(pos_) + T, 0, S)
+        n_chunks = jax.lax.div(live + chunk - 1, chunk)
+        a, b_seg = paged_segments(matched_, chunk, n_chunks)
 
-        def load_slab(start):
+        def slab_copies(i, slot):
             # one sliced DMA per half: the first B slab rows' chunk window
             # (a dispatch bucket below B_max reads only its own rows,
             # mirroring kvc.slice_rows_batched(rows=B))
-            for r, s in zip(slab_k, sk_scr):
-                copy(r.at[pl.ds(0, B), pl.ds(start, chunk)], s)
-            for r, s in zip(slab_v, sv_scr):
-                copy(r.at[pl.ds(0, B), pl.ds(start, chunk)], s)
+            return [
+                pltpu.make_async_copy(
+                    r.at[pl.ds(0, B), pl.ds(i * chunk, chunk)],
+                    s.at[slot],
+                    sem.at[slot],
+                )
+                for r, s in zip(slab_k + slab_v, sk_scr + sv_scr)
+            ]
 
-        def load_pool(i):
+        def pool_copies(i, slot):
             # page-table-routed copies: page p of chunk i for row b comes
             # from pool page tables[b, i*ppc + p]. The table window start
             # clamps exactly like the scan's lax.dynamic_slice on tables.
             base = jnp.clip(i * ppc, 0, n_table - ppc)
+            cs = []
             for b in range(B):
                 for p in range(ppc):
                     pid = tables_ref[b, base + p]
-                    for r, s in zip(pk, pk_scr):
-                        copy(r.at[pid], s.at[b, pl.ds(p * page, page)])
-                    for r, s in zip(pv, pv_scr):
-                        copy(r.at[pid], s.at[b, pl.ds(p * page, page)])
+                    cs.extend(
+                        pltpu.make_async_copy(
+                            r.at[pid],
+                            s.at[slot, b, pl.ds(p * page, page)],
+                            sem.at[slot],
+                        )
+                        for r, s in zip(pk + pv, pk_scr + pv_scr)
+                    )
+            return cs
 
-        def read(scrs):
+        def start_loads(i, slot):
+            # chunk i's sources by segment: slab from chunk a up, pool
+            # below chunk b_seg — slab-only chunks issue ZERO pool
+            # traffic, exactly like the scan's segment split
+            @pl.when(i >= a)
+            def _():
+                for c in slab_copies(i, slot):
+                    c.start()
+
+            @pl.when(i < b_seg)
+            def _():
+                for c in pool_copies(i, slot):
+                    c.start()
+
+        def wait_loads(i, slot):
+            # recreate the started descriptors (same refs, same sem slot);
+            # every copy of the chunk is drained before any scratch read.
+            # slots alternate, so chunk i+1's in-flight copies signal the
+            # OTHER slot's semaphore and can never satisfy these waits.
+            @pl.when(i >= a)
+            def _():
+                for c in slab_copies(i, slot):
+                    c.wait()
+
+            @pl.when(i < b_seg)
+            def _():
+                for c in pool_copies(i, slot):
+                    c.wait()
+
+        def read(scrs, slot):
             if quant:
-                return kvc.QuantizedKV(scrs[0][:], scrs[1][:])
-            return scrs[0][:]
+                return kvc.QuantizedKV(scrs[0][slot], scrs[1][slot])
+            return scrs[0][slot]
 
-        pos_ = pos_ref[:]
-        matched_ = matched_ref[:]
-        partial = _decode_partial(qg_ref[:], pos_, chunk, cdt, prec)
-        live = jnp.clip(jnp.max(pos_) + 1, 0, S)
-        n_chunks = jax.lax.div(live + chunk - 1, chunk)
-        a, b_seg = paged_segments(matched_, chunk, n_chunks)
+        def with_loads(compute):
+            """Wrap a segment body with the DMA schedule. Double-buffered:
+            start chunk i+1's copies into the other slot FIRST, so they fly
+            under chunk i's einsums; segment membership is resolved per
+            chunk index, so the prefetch crosses segment (and fori_loop)
+            boundaries without special cases. Serial: start+wait the
+            chunk's own copies, nothing in flight during compute."""
 
-        def body_pool(i, carry):
-            load_pool(i)
-            return partial(read(pk_scr), read(pv_scr), i * chunk, carry)
+            def body_fn(i, carry):
+                slot = jax.lax.rem(i, nslots)
+                if double_buffer:
+                    @pl.when(i + 1 < n_chunks)
+                    def _():
+                        start_loads(i + 1, jax.lax.rem(i + 1, nslots))
+                else:
+                    start_loads(i, slot)
+                wait_loads(i, slot)
+                return compute(i, slot, carry)
 
-        def body_mixed(i, carry):
-            load_slab(i * chunk)
-            load_pool(i)
+            return body_fn
+
+        def compute_pool(i, slot, carry):
+            return partial(read(pk_scr, slot), read(pv_scr, slot), i * chunk, carry)
+
+        def compute_mixed(i, slot, carry):
             sel = (i * chunk + jnp.arange(chunk))[None, :] < matched_[:, None]
-            kc = kvc.select_kv(sel, read(pk_scr), read(sk_scr))
-            vc = kvc.select_kv(sel, read(pv_scr), read(sv_scr))
+            kc = kvc.select_kv(sel, read(pk_scr, slot), read(sk_scr, slot))
+            vc = kvc.select_kv(sel, read(pv_scr, slot), read(sv_scr, slot))
             return partial(kc, vc, i * chunk, carry)
 
-        def body_slab(i, carry):
-            load_slab(i * chunk)
-            return partial(read(sk_scr), read(sv_scr), i * chunk, carry)
+        def compute_slab(i, slot, carry):
+            return partial(read(sk_scr, slot), read(sv_scr, slot), i * chunk, carry)
 
-        m0 = jnp.full((B, K, M), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((B, K, M), jnp.float32)
-        o0 = jnp.zeros((B, K, M, hd), jnp.float32)
-        carry = jax.lax.fori_loop(0, a, body_pool, (m0, l0, o0))
-        carry = jax.lax.fori_loop(a, b_seg, body_mixed, carry)
-        m, l, o = jax.lax.fori_loop(b_seg, n_chunks, body_slab, carry)
+        if double_buffer:
+            # warm-up: chunk 0's copies have no prior compute to hide under
+            @pl.when(n_chunks > 0)
+            def _():
+                start_loads(0, 0)
+
+        m0 = jnp.full(lead, -jnp.inf, jnp.float32)
+        l0 = jnp.zeros(lead, jnp.float32)
+        o0 = jnp.zeros(lead + (hd,), jnp.float32)
+        carry = jax.lax.fori_loop(0, a, with_loads(compute_pool), (m0, l0, o0))
+        carry = jax.lax.fori_loop(a, b_seg, with_loads(compute_mixed), carry)
+        m, l, o = jax.lax.fori_loop(b_seg, n_chunks, with_loads(compute_slab), carry)
         out_ref[:] = o / jnp.maximum(l, 1e-30)[..., None]
 
     any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
@@ -441,11 +552,11 @@ def fused_paged_decode_attention(
     scratch = (
         scratch_for(keys, B) + scratch_for(values, B)
         + scratch_for(pool_k, B) + scratch_for(pool_v, B)
-        + [pltpu.SemaphoreType.DMA]
+        + [pltpu.SemaphoreType.DMA((nslots,))]
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B, K, M, hd), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(lead + (hd,), jnp.float32),
         in_specs=in_specs,
         out_specs=any_spec,
         scratch_shapes=scratch,
@@ -455,6 +566,46 @@ def fused_paged_decode_attention(
         pos.astype(jnp.int32), matched.astype(jnp.int32),
         tables.astype(jnp.int32), qg,
         *halves(keys), *halves(values), *halves(pool_k), *halves(pool_v),
+    )
+
+
+def fused_paged_decode_attention(
+    qg: jax.Array,  # [B, K, M, hd] f32 grouped queries (one token per row)
+    keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
+    values,
+    pos: jax.Array,  # [B] per-row absolute positions
+    chunk: int,
+    paged,  # (pool_k, pool_v, tables [B, n_table], matched [B])
+    interpret: bool | None = None,
+    double_buffer: bool | None = None,
+) -> jax.Array:
+    """The fused Pallas form of the paged :func:`batched_decode_attention`
+    hit path — same segment split, same chunk order, same merge arithmetic,
+    bit-identical output. ``double_buffer`` (default: env ``DLT_FUSED_DB``,
+    on) overlaps chunk i+1's page/slab DMAs with chunk i's einsums.
+    Returns [B, K, M, hd] f32."""
+    return _fused_paged_attention(
+        qg, keys, values, pos, chunk, paged, interpret, double_buffer, verify=False
+    )
+
+
+def fused_paged_verify_attention(
+    qg: jax.Array,  # [B, T, K, M, hd] f32 grouped queries (T = draft k + 1)
+    keys,  # slab cache half [B, S, K, hd] (array or QuantizedKV)
+    values,
+    pos: jax.Array,  # [B] per-row positions of query t=0
+    chunk: int,
+    paged,  # (pool_k, pool_v, tables [B, n_table], matched [B])
+    interpret: bool | None = None,
+    double_buffer: bool | None = None,
+) -> jax.Array:
+    """The fused Pallas form of the paged :func:`batched_verify_attention`
+    hit path (speculative decode) — the same kernel as the decode form with
+    the T-query verify arithmetic (:func:`_verify_partial`) in the chunk
+    body, so each query's output stays bit-identical to the single-token
+    decode step at the same position. Returns [B, T, K, M, hd] f32."""
+    return _fused_paged_attention(
+        qg, keys, values, pos, chunk, paged, interpret, double_buffer, verify=True
     )
 
 
@@ -479,31 +630,25 @@ def batched_verify_attention(
     ``paged``: the zero-copy prefix read, segmented exactly like
     :func:`batched_decode_attention` — the verify window always sits at
     pos >= matched, so every paged position is causally visible to every
-    query offset and the per-chunk math is unchanged."""
+    query offset and the per-chunk math is unchanged. The paged hit path
+    dispatches to the fused Pallas kernel under the same eligibility gate
+    as decode (:func:`_fused_paged_eligible`, ``DLT_FUSED_PAGED``)."""
     B, T, K, M, hd = qg.shape
     S = keys.shape[1]
+    if paged is not None and _fused_paged_eligible(qg, keys, values, paged, chunk):
+        from distributed_llama_tpu import telemetry
+
+        telemetry.note_kernel_path("paged_attention", "pallas_fused_verify")
+        return fused_paged_verify_attention(qg, keys, values, pos, chunk, paged)
+    if paged is not None:
+        from distributed_llama_tpu import telemetry
+
+        telemetry.note_kernel_path("paged_attention", "xla_segmented")
     cdt = kvc.compute_dtype(keys)
     prec = kvc.einsum_precision(keys)
     live = jnp.clip(jnp.max(pos) + T, 0, S)
     n_chunks = jax.lax.div(live + chunk - 1, chunk)
-    q_pos = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
-
-    def partial(kc, vc, start, carry):
-        m, l, o = carry
-        k_pos = start + jnp.arange(chunk)
-        scores = kvc.scores_einsum_verify(qg.astype(cdt), kc, prec) / jnp.sqrt(
-            jnp.float32(hd)
-        )  # [B, T, K, M, chunk]
-        mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, :, None, None, :]
-        scores = jnp.where(mask, scores, -jnp.inf)
-        ms = jnp.max(scores, axis=-1)
-        safe_m = jnp.where(jnp.isfinite(ms), ms, 0.0)
-        p = jnp.exp(scores - safe_m[..., None])
-        p = jnp.where(mask, p, 0.0)
-        ls = jnp.sum(p, axis=-1)
-        os_ = kvc.mix_einsum_verify(p, vc, cdt, prec)
-        return merge_partials(m, l, o, ms, ls, os_)
-
+    partial = _verify_partial(qg, pos, chunk, cdt, prec)
     m0 = jnp.full((B, T, K, M), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, T, K, M), jnp.float32)
     o0 = jnp.zeros((B, T, K, M, hd), jnp.float32)

@@ -134,6 +134,265 @@ def all_reduce(x: jax.Array, axis_name: str | None, impl: str | None = None) -> 
 
 
 # ---------------------------------------------------------------------------
+# Fused matmul + all-reduce (decode superstep, part b)
+# ---------------------------------------------------------------------------
+#
+# Under ``psum`` (and the unfused ring) the wo/down matmul and the
+# all-reduce are strictly sequential: the collective's first byte cannot
+# leave until the LAST output column lands. But the ring schedule only
+# needs ONE chunk to start its first hop — so the fused kernel below
+# computes each output chunk's int8 matmul ON DEMAND inside the
+# reduce-scatter walk and starts both directions' remote copies BEFORE
+# computing the next step's chunks: the next tile's MXU work runs while
+# the copies are in flight, which is the overlap the ISSUE's superstep
+# buys over psum. The seam (:func:`matmul_all_reduce`) keeps the same
+# safety ladder as :func:`all_reduce`: the fused kernel engages only
+# under ``DLT_ALLREDUCE=ring`` + the int8 q40 path + an eligible shape,
+# and ANY failure falls back to the unfused matmul + all_reduce arms
+# (whose ring_xla/psum parity is pinned on the CPU mesh).
+
+
+def _fused_ring_eligible(x: jax.Array, qm, n: int) -> bool:
+    """Shape/VMEM gate for the fused kernel: the 2n column chunks must be
+    lane-aligned (w % 128), the n tiling must match the standalone int8
+    kernel's (same f32 accumulation order → bit-parity by construction),
+    and every operand must fit VMEM simultaneously (the kernel takes no
+    grid — decode payloads only)."""
+    from distributed_llama_tpu.quants import QK
+    from distributed_llama_tpu.ops.q40 import BLOCK_N, _largest_divisor_tile
+
+    T = x.shape[0]
+    np_, dp = qm.n_padded, qm.d_padded
+    if T > 8 or dp % (2 * n) or qm.qs.ndim != 2:
+        return False
+    w = dp // (2 * n)
+    if w % 128 or _largest_divisor_tile(np_, BLOCK_N, 512) is None:
+        return False
+    vmem = (
+        np_ // 2 * dp  # qs (uint8)
+        + np_ // QK * dp * 4  # scales (f32)
+        + T * np_  # xq (int8)
+        + 2 * T * np_ // QK * 4  # sx + xsum (f32)
+        + 3 * 2 * n * T * w * 4  # out + comm/scratch slots (f32)
+    )
+    return vmem < 10 * 2**20  # ~16 MB/core VMEM, leave headroom
+
+
+def _make_fused_matmul_ring_kernel(axis_name: str, n: int, nj: int, w: int):
+    """The fused int8-matmul + bidirectional-ring kernel factory.
+
+    Ring schedule and chunk layout are IDENTICAL to
+    :func:`_make_ring_kernel` (index 2c+d = ring d's chunk at position c);
+    the difference is that ``local_chunk`` COMPUTES its chunk — the
+    Q40×Q80 per-block int8 dot over output columns [k*w, (k+1)*w) plus the
+    +8-bias correction — instead of loading a precomputed product, and the
+    reduce-scatter step starts both remote copies BEFORE computing the
+    next chunks so the MXU work overlaps the in-flight DMAs.
+
+    The per-chunk matmul replicates the standalone kernel's accumulation
+    structure exactly (``nj`` sequential block_n tiles, each adding its
+    lo-half then hi-half per-block sums into the f32 accumulator — the
+    ``_q40_matmul_int8`` grid order) so fused and unfused paths agree
+    bitwise, not just approximately."""
+    from distributed_llama_tpu.quants import QK
+
+    def kernel(xq_ref, sx_ref, xsum_ref, qs_ref, scales_ref, out_ref,
+               comm_ref, scratch_ref, send_sem, recv_sem):
+        my = lax.axis_index(axis_name)
+        neighbor = (jnp.mod(my + 1, n), jnp.mod(my - 1, n))  # cw, ccw
+        np2 = qs_ref.shape[0]  # packed rows = n_pad/2
+        bn2 = np2 // nj  # packed rows per block_n tile
+        nbt = bn2 // QK  # quant blocks per tile per half
+        T = xq_ref.shape[0]
+
+        def compute_chunk(k):
+            """out[:, k*w:(k+1)*w] of THIS shard's x @ dequant(qm): the
+            int8 block-dot epilogue, on demand."""
+            cols = pl.ds(k * w, w)
+
+            def half(xqh, sxh, nib, swh):
+                xb = xqh.reshape(T, nbt, QK)
+                wb = nib.reshape(nbt, QK, w)
+                P = jax.lax.dot_general(
+                    xb, wb, (((2,), (1,)), ((1,), (0,))),
+                    preferred_element_type=jnp.int32,
+                )  # [nbt, T, w]
+                scaled = P.astype(jnp.float32) * swh[:, None, :]
+                return jnp.sum(scaled * jnp.transpose(sxh)[:, :, None], axis=0)
+
+            def tile(j, acc):
+                qs = qs_ref[pl.ds(j * bn2, bn2), cols]
+                lo = (qs & 0xF).astype(jnp.int8)
+                hi = (qs >> 4).astype(jnp.int8)
+                acc += half(
+                    xq_ref[:, pl.ds(j * bn2, bn2)],
+                    sx_ref[:, pl.ds(j * nbt, nbt)],
+                    lo,
+                    scales_ref[pl.ds(j * nbt, nbt), cols],
+                )
+                acc += half(
+                    xq_ref[:, pl.ds((nj + j) * bn2, bn2)],
+                    sx_ref[:, pl.ds((nj + j) * nbt, nbt)],
+                    hi,
+                    scales_ref[pl.ds((nj + j) * nbt, nbt), cols],
+                )
+                return acc
+
+            acc = lax.fori_loop(0, nj, tile, jnp.zeros((T, w), jnp.float32))
+            # the +8 nibble-bias correction for THESE columns (per-shard:
+            # the cross-shard sum of per-shard corrections is the global
+            # correction, so the ring's adds need no special casing)
+            corr = jax.lax.dot_general(
+                xsum_ref[:], scales_ref[:, cols],
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            return acc - 8.0 * corr
+
+        def start_hop(d, slot, value):
+            scratch_ref[d, slot] = value
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=scratch_ref.at[d, slot],
+                dst_ref=comm_ref.at[d, slot],
+                send_sem=send_sem.at[d],
+                recv_sem=recv_sem.at[d],
+                device_id=(neighbor[d],),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            return rdma
+
+        def rs_step(s, carry):
+            p_cw, p_ccw = carry
+            slot = s % 2
+            r0 = start_hop(0, slot, p_cw)
+            r1 = start_hop(1, slot, p_ccw)
+            # THE overlap: this step's chunk matmuls run on the MXU while
+            # both remote copies are in flight
+            add_cw = compute_chunk(2 * jnp.mod(my - s, n))
+            add_ccw = compute_chunk(2 * jnp.mod(my + s, n) + 1)
+            r0.wait()
+            r1.wait()
+            return comm_ref[0, slot] + add_cw, comm_ref[1, slot] + add_ccw
+
+        p_cw, p_ccw = lax.fori_loop(
+            1, n, rs_step, (compute_chunk(2 * my), compute_chunk(2 * my + 1))
+        )
+        pl.store(out_ref, (2 * jnp.mod(my + 1, n),), p_cw)
+        pl.store(out_ref, (2 * jnp.mod(my - 1, n) + 1,), p_ccw)
+
+        def ag_step(s, carry):
+            c_cw, c_ccw = carry
+            slot = s % 2
+            r0 = start_hop(0, slot, c_cw)
+            r1 = start_hop(1, slot, c_ccw)
+            r0.wait()
+            r1.wait()
+            got_cw, got_ccw = comm_ref[0, slot], comm_ref[1, slot]
+            pl.store(out_ref, (2 * jnp.mod(my - s + 1, n),), got_cw)
+            pl.store(out_ref, (2 * jnp.mod(my + s - 1, n) + 1,), got_ccw)
+            return got_cw, got_ccw
+
+        lax.fori_loop(1, n, ag_step, (p_cw, p_ccw))
+
+    return kernel
+
+
+def fused_matmul_ring_all_reduce(x: jax.Array, qm, axis_name: str, n: int) -> jax.Array:
+    """psum_over_shards(x @ dequant(qm)) as ONE Pallas program: Q80
+    quantize (outside — elementwise, XLA fuses it into the caller), then
+    the int8 matmul computed chunk-by-chunk INSIDE the bidirectional ring
+    reduce-scatter, remote copies overlapping the next chunks' MXU work.
+    TPU compiled mode only, exactly like :func:`ring_all_reduce` (remote
+    DMA cannot run interpreted on the container's jax); callers reach it
+    through the :func:`matmul_all_reduce` seam, which guards eligibility
+    and falls back to the unfused arms on any failure."""
+    from distributed_llama_tpu.quants import QK
+    from distributed_llama_tpu.ops.q40 import (
+        BLOCK_N,
+        _largest_divisor_tile,
+        quantize_q80,
+        tpu_compiler_params,
+    )
+
+    params = tpu_compiler_params(has_side_effects=True, collective_id=1)
+    if not params:
+        raise RuntimeError(
+            "pallas compiler params lack has_side_effects/collective_id; "
+            "refusing to build the fused matmul+ring kernel without them"
+        )
+    np_, dp = qm.n_padded, qm.d_padded
+    T = x.shape[0]
+    w = dp // (2 * n)
+    bn = _largest_divisor_tile(np_, BLOCK_N, 512)
+    nj = np_ // bn
+    if x.shape[-1] != np_:
+        x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
+    xq, sx = quantize_q80(x)
+    qsum = jnp.sum(xq.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
+    xsum = sx * qsum
+    slot = (2, 2, T, w)
+    out = pl.pallas_call(
+        _make_fused_matmul_ring_kernel(axis_name, n, nj, w),
+        out_shape=jax.ShapeDtypeStruct((2 * n, T, w), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM(slot, jnp.float32),  # recv slots (remote writes)
+            pltpu.VMEM(slot, jnp.float32),  # send staging
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        **params,
+    )(xq, sx, xsum, qm.qs, qm.scales)
+    flat = jnp.concatenate(list(out), axis=-1)  # [T, dp]
+    return flat[:, : qm.d] if dp != qm.d else flat
+
+
+def matmul_all_reduce(
+    x: jax.Array, w, axis_name: str | None, impl: str | None = None
+) -> jax.Array:
+    """THE matmul+all-reduce seam: ``sum_over_shards(x @ w)``, replicated
+    identically on every shard — what ``models.llama.block_tail``/``ffn``
+    route the wo/down projections through. ``axis_name=None`` is the
+    single-chip plain matmul. Dispatch ladder: the fused int8+ring Pallas
+    kernel when ``DLT_ALLREDUCE=ring`` + the int8 q40 path + an eligible
+    shape (noted ``fused_ring``); otherwise the unfused matmul followed by
+    :func:`all_reduce` under the chosen impl (psum / ring_xla / ring).
+    Arm parity (tests/test_kernel_parity.py): the psum arm is exactly the
+    unfused composition; ring-schedule arms agree within summation-order
+    tolerance (a ring accumulates each chunk in ring order — a different
+    f32 association than psum); the fused kernel replicates the unfused
+    int8 matmul's tile accumulation order per chunk, so its divergence
+    from the psum arm is the same association-only delta."""
+    from distributed_llama_tpu.models.llama import _matmul
+
+    if axis_name is None:
+        return _matmul(x, w)
+    if impl is None:
+        impl = default_impl()
+    if impl == "ring":
+        from distributed_llama_tpu.ops.q40 import QuantizedMatrix, default_q40_path
+
+        n = _axis_size(axis_name)
+        if (
+            n is not None
+            and n > 1
+            and isinstance(w, QuantizedMatrix)
+            and not w.interleaved
+            and default_q40_path() == "int8"
+            and _fused_ring_eligible(x, w, n)
+        ):
+            try:
+                out = fused_matmul_ring_all_reduce(x, w, axis_name, n)
+                _note("fused_ring")
+                return out
+            except Exception:
+                pass  # unfused arms below are the safety net
+    return all_reduce(_matmul(x, w), axis_name, impl)
+
+
+# ---------------------------------------------------------------------------
 # Ring schedule via ppermute (the CPU-mesh realization + parity reference)
 # ---------------------------------------------------------------------------
 

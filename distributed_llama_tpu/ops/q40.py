@@ -105,12 +105,13 @@ class QuantizedMatrix:
     (padding carries zero *scales*, so padded rows/columns dequantize to
     exact zeros); ``n``/``d`` are the logical (unpadded) matmul dims.
 
-    ``interleaved``: the input rows are stored in the block-interleaved
-    basis (see :func:`interleave_input_rows`) — the kernel then broadcasts
-    scales with the cheap tiled ``pltpu.repeat`` (row p ← scale[p % nb])
-    instead of the per-32-row ``jnp.repeat`` expansion, measured ~+18% on
-    a 7B decode. ``packed_bn`` records the block_n the interleave was built
-    for (the kernel must tile with exactly that window).
+    ``interleaved``: the input rows are stored in the RETIRED
+    block-interleaved basis (see the legacy section below) — such packs
+    only exist transiently at load time now; every matmul entry point
+    rejects them, and ``deinterleave_input_rows`` /
+    ``weights.remove_basis_interleave`` move them back to the standard
+    basis. ``packed_bn`` records the block_n the interleave was built for
+    (the inverse gather needs exactly that window).
     """
 
     qs: jax.Array  # uint8 [..., n_pad/2, d_pad]
@@ -298,50 +299,40 @@ def concat_shard_packs(mats: list[QuantizedMatrix], axis: str) -> QuantizedMatri
     return QuantizedMatrix(qs, scales, n_logical=m0.n, d_logical=m0.d)
 
 
-def _packed_scale_index(n_pad: int, W: int) -> np.ndarray:
-    """Scale-row index of every packed-order row (concat lo|hi) of an
-    INTERLEAVED matrix: row p of window w belongs to block w*nb + p % nb."""
-    nbt = W // QK
-    p = np.arange(n_pad // 2)
-    lo = (p // W) * nbt + (p % nbt)
-    return np.concatenate([lo, n_pad // (2 * QK) + lo])
-
-
 def dequantize_tpu(qm: QuantizedMatrix) -> np.ndarray:
-    """Reference unpacking of the TPU layout → f32 [n, d] in the matrix's
-    OWN basis (for an interleaved matrix, the permuted row order its
-    activations use). Trims any tile padding back to the logical dims —
-    interleaved matrices keep the padded n (their basis has no trim)."""
+    """Reference unpacking of the TPU layout → f32 [n, d] (standard basis).
+    Trims any tile padding back to the logical dims."""
+    if qm.interleaved:
+        raise ValueError(
+            "interleaved pack: the block-interleaved basis is retired — "
+            "de-interleave at load (q40.deinterleave_input_rows / "
+            "weights.remove_basis_interleave)"
+        )
     qs = np.asarray(qm.qs)
     scales = np.asarray(qm.scales)
     # half-split: low nibbles are logical rows [0, half), high [half, n_pad)
     lo = (qs & 0xF).astype(np.int8) - 8
     hi = (qs >> 4).astype(np.int8) - 8
     vals = np.concatenate([lo, hi], axis=0)
-    if qm.interleaved:
-        scale_full = scales[_packed_scale_index(qm.n_padded, qm.packed_bn // 2)]
-        return (vals.astype(np.float32) * scale_full)[:, : qm.d]
     scale_full = np.repeat(scales, QK, axis=0)
     return (vals.astype(np.float32) * scale_full)[: qm.n, : qm.d]
 
 
 # ---------------------------------------------------------------------------
-# Block-interleaved feature basis
+# Legacy block-interleaved feature basis (migration shims only)
 # ---------------------------------------------------------------------------
 #
-# The kernel's one remaining VPU heavyweight is the scale broadcast: scale
-# row b must multiply 32 CONSECUTIVE weight rows, which jnp.repeat expands
-# per grid step. pltpu.repeat is far cheaper (it tiles whole copies of the
-# scales tile: row p <- scale[p % nb]) but wrong for consecutive-row blocks.
-# Reordering the rows so that block membership IS p % nb makes it exact:
-# within every `window` of W = block_n/2 packed rows, position o holds
-# original feature (o % nb)*32 + o//nb (nb = W/32). The activations must
-# live in the same permuted basis — achieved at LOAD time by permuting
-# every producer of that basis (embedding columns, wo/down output columns,
-# rmsnorm vectors) with the same permutation, so no runtime permutes exist
-# anywhere. Scales stay in original block order (the permutation maps block
-# c of window w to scale row w*nb + c, exactly where it already is).
-# Measured: 9.98 -> ~8.5 ms/token on the 7B decode (docs/PERF.md round 5).
+# Rounds 5-13 reordered kernel-eligible input rows so block membership was
+# p % nb, letting the f32 VPU-dequant kernel broadcast scales with the cheap
+# tiled pltpu.repeat (measured ~+18% on a 7B decode). The int8 MXU path made
+# that win moot — its scale product is a per-block epilogue, not a per-row
+# broadcast — so the basis (and its load-time permutes of every producer)
+# is RETIRED: the kernels below dispatch on the standard basis only, and
+# ``q40_matmul`` rejects interleaved packs outright. What remains here is
+# the migration surface: the permutation math, the legacy producers (so
+# tests can synthesize basis-era params trees), and the EXACT inverse
+# gathers (``deinterleave_*``) that move an interleaved checkpoint back to
+# the standard basis at load time (engine.weights.remove_basis_interleave).
 
 
 def interleave_window(n_pad: int) -> int | None:
@@ -365,9 +356,11 @@ def interleave_perm(n: int, W: int) -> np.ndarray:
 
 
 def interleave_input_rows(qm: QuantizedMatrix) -> QuantizedMatrix:
-    """Reorder a standard pack's input rows into the interleaved basis —
-    a pure row gather (scales unchanged); exact. The gather runs wherever
-    the pack lives (on device for a loaded model — no host round trip).
+    """LEGACY producer: reorder a standard pack's input rows into the
+    interleaved basis — a pure row gather (scales unchanged); exact. The
+    runtime no longer consumes this basis; the producer is retained so
+    migration tests can synthesize basis-era packs and round-trip them
+    through :func:`deinterleave_input_rows`.
     Returns the matrix unchanged if not kernel-eligible or already done."""
     if qm.interleaved:
         return qm
@@ -382,6 +375,65 @@ def interleave_input_rows(qm: QuantizedMatrix) -> QuantizedMatrix:
         qs, qm.scales, qm.n_logical, qm.d_logical,
         interleaved=True, packed_bn=2 * W,
     )
+
+
+def deinterleave_input_rows(qm: QuantizedMatrix) -> QuantizedMatrix:
+    """The migration shim: move an interleaved pack's input rows back to
+    the standard basis — the EXACT inverse gather of
+    :func:`interleave_input_rows` (scales were never permuted, so only the
+    packed qs rows move). Standard packs pass through unchanged, so the
+    loader can apply this unconditionally to a checkpoint of unknown
+    vintage."""
+    if not qm.interleaved:
+        return qm
+    half = qm.n_padded // 2
+    perm = interleave_perm(half, qm.packed_bn // 2)
+    inv = jnp.asarray(np.argsort(perm))
+    qs = jnp.take(jnp.asarray(qm.qs), inv, axis=0)
+    return QuantizedMatrix(qs, qm.scales, qm.n_logical, qm.d_logical)
+
+
+def deinterleave_output_cols(
+    qm: QuantizedMatrix, n_consumer_logical: int, halves: int = 1
+) -> QuantizedMatrix:
+    """Inverse of :func:`interleaved_output_cols`: gather the producer's
+    output columns back to the standard feature order and restore the
+    original d padding (the consumer-basis pad positions sourced zero-scale
+    columns, and zero-scale columns are exactly what the standard pack's d
+    padding holds — so the round trip is bit-exact)."""
+    npc = _n_padded(n_consumer_logical)
+    W = interleave_window(npc)
+    if W is None or qm.d != halves * npc:
+        return qm  # never moved to the consumer basis
+    perm = interleave_perm(npc, W)
+    inv = np.argsort(perm)[:n_consumer_logical]  # drop consumer-basis pads
+    cols = np.concatenate([h * npc + inv for h in range(halves)])
+    d_orig = halves * n_consumer_logical
+    d_pad = _d_padded(d_orig)
+    qs = np.asarray(jnp.take(jnp.asarray(qm.qs), jnp.asarray(cols), axis=1))
+    scales = np.asarray(
+        jnp.take(jnp.asarray(qm.scales), jnp.asarray(cols), axis=1)
+    )
+    if d_pad != d_orig:
+        qs = np.pad(qs, ((0, 0), (0, d_pad - d_orig)))
+        scales = np.pad(scales, ((0, 0), (0, d_pad - d_orig)))
+    return QuantizedMatrix(
+        jnp.asarray(qs), jnp.asarray(scales), qm.n_logical, d_orig,
+        interleaved=qm.interleaved, packed_bn=qm.packed_bn,
+    )
+
+
+def deinterleave_vector(v, n_logical: int):
+    """Inverse of :func:`interleave_vector`: un-permute a feature vector
+    (or an embedding table's last axis) and trim the basis padding."""
+    npc = _n_padded(n_logical)
+    W = interleave_window(npc)
+    v = jnp.asarray(v)
+    if W is None or v.shape[-1] != npc:
+        return v
+    perm = interleave_perm(npc, W)
+    inv = jnp.asarray(np.argsort(perm))
+    return jnp.take(v, inv, axis=-1)[..., :n_logical]
 
 
 def interleaved_output_cols(
@@ -438,7 +490,7 @@ def interleave_vector(v, n_logical: int):
     return jnp.take(v, jnp.asarray(perm), axis=-1)
 
 
-def _make_q40_kernel(compute_dtype, interleaved: bool = False, interpret: bool = False):
+def _make_q40_kernel(compute_dtype, interpret: bool = False):
     """Kernel factory: one (d-tile, n-tile) grid step dequantizes the weight
     tile in VMEM and accumulates into the f32 accumulator.
 
@@ -466,30 +518,14 @@ def _make_q40_kernel(compute_dtype, interleaved: bool = False, interpret: bool =
         # qs holds u8 values, so >>4 is already in 0..15 — no mask needed
         # (dropping the redundant & 0xF is worth ~25% on the VPU-bound unpack)
         hi = (qs >> 4).astype(compute_dtype)
-        bn2, bd = qs.shape
-        if interleaved:
-            # block-interleaved rows: membership of row p is p % nb, so the
-            # scale broadcast is a whole-tile tiling — pltpu.repeat on TPU
-            # (measured ~+18% over the jnp.repeat expansion on a 7B decode),
-            # jnp.tile (same semantics) in interpret mode
-            if interpret:
-                wlo = lo * jnp.tile(slo_ref[:].astype(compute_dtype), (QK, 1))
-                whi = hi * jnp.tile(shi_ref[:].astype(compute_dtype), (QK, 1))
-            else:
-                wlo = lo * pltpu.repeat(slo_ref[:].astype(compute_dtype), QK, 0)
-                whi = hi * pltpu.repeat(shi_ref[:].astype(compute_dtype), QK, 0)
-        else:
-            # CONSECUTIVE logical rows: each scale row broadcasts over its
-            # 32-row block. jnp.repeat expands the SMALL scales tile to
-            # [bn2, bd] and multiplies in 2-D — reshaping the big nibble
-            # tile to [blocks, 32, bd] and back instead costs Mosaic
-            # relayouts on the large array (measured 61 -> 68 tok/s
-            # end-to-end on a 7B decode). pltpu.repeat would be faster
-            # still but tiles whole copies (s[r % nb], not s[r // 32]) —
-            # numerically wrong for this row order; the interleaved layout
-            # above exists precisely to make it right.
-            wlo = lo * jnp.repeat(slo_ref[:].astype(compute_dtype), QK, axis=0)
-            whi = hi * jnp.repeat(shi_ref[:].astype(compute_dtype), QK, axis=0)
+        # CONSECUTIVE logical rows: each scale row broadcasts over its
+        # 32-row block. jnp.repeat expands the SMALL scales tile to
+        # [bn2, bd] and multiplies in 2-D — reshaping the big nibble
+        # tile to [blocks, 32, bd] and back instead costs Mosaic
+        # relayouts on the large array (measured 61 -> 68 tok/s
+        # end-to-end on a 7B decode).
+        wlo = lo * jnp.repeat(slo_ref[:].astype(compute_dtype), QK, axis=0)
+        whi = hi * jnp.repeat(shi_ref[:].astype(compute_dtype), QK, axis=0)
         acc_ref[:] += jnp.dot(xlo_ref[:], wlo, preferred_element_type=jnp.float32)
         acc_ref[:] += jnp.dot(xhi_ref[:], whi, preferred_element_type=jnp.float32)
 
@@ -508,12 +544,7 @@ def _resolve_tiles(qm: QuantizedMatrix, T: int, block_n: int, block_d: int):
     tiling rules) — smaller matrices take the XLA fallback."""
     _validate_env_tiles()
     block_d = _shrink_block_d(T, block_d)
-    if qm.interleaved:
-        # the row interleave was built for exactly this window; any other
-        # block_n would pair wrong scales with wrong rows
-        block_n = qm.packed_bn
-    else:
-        block_n = _largest_divisor_tile(qm.n_padded, block_n, 512)
+    block_n = _largest_divisor_tile(qm.n_padded, block_n, 512)
     block_d = _largest_divisor_tile(qm.d_padded, block_d, 128)
     if block_n is None or block_d is None:
         return None
@@ -557,8 +588,14 @@ def q40_matmul(
     * XLA fallback for matrices too small/odd to tile (either ``path``).
 
     Every dispatch decision is counted in ``dllama_kernel_path_total``
-    (mxu_int8 / vpu_f32 / xla_fallback) so a silent fallback to the slow
-    path is visible in /metrics."""
+    (mxu_int8 / mxu_int8_fusedq / vpu_f32 / xla_fallback) so a silent
+    fallback to the slow path is visible in /metrics."""
+    if qm.interleaved:
+        raise ValueError(
+            "interleaved pack: the block-interleaved basis is retired — "
+            "de-interleave at load (q40.deinterleave_input_rows / "
+            "weights.remove_basis_interleave)"
+        )
     tiles = _resolve_tiles(qm, x.shape[0], block_n, block_d)
     if tiles is None:
         _note_path("q40_matmul", "xla_fallback")
@@ -594,12 +631,6 @@ def _q40_matmul_f32(
     T = x.shape[0]
 
     if x.shape[-1] != np_:
-        if qm.interleaved:
-            # the interleaved basis intersperses pad features; a narrower x
-            # is a basis mismatch, not something end-padding can fix
-            raise ValueError(
-                f"interleaved matmul needs x width {np_}, got {x.shape[-1]}"
-            )
         x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
     compute_dtype = jnp.float32 if interpret else jnp.bfloat16
     xb = x.astype(compute_dtype)
@@ -609,7 +640,7 @@ def _q40_matmul_f32(
     # views over the same array — window j for the low nibbles, window
     # nj + j (the upper half) for the high nibbles. Contiguous, gather-free.
     out = pl.pallas_call(
-        _make_q40_kernel(compute_dtype, interleaved=qm.interleaved, interpret=interpret),
+        _make_q40_kernel(compute_dtype, interpret=interpret),
         grid=grid,
         in_specs=[
             pl.BlockSpec((T, block_n // 2), lambda i, j: (0, j)),
@@ -631,18 +662,7 @@ def _q40_matmul_f32(
     # magnitude, so bf16 accumulation error here would dominate the result
     # (measured 6x accuracy loss) — f32 makes it the exact sum of the same
     # bf16 x values the kernel consumed.
-    if qm.interleaved:
-        # interleaved rows: window W holds its blocks' elements strided by
-        # nb (position o = q*nb + c belongs to block c of the window), so
-        # the per-block sum groups [W] as [QK, nb]; the flattened (w, c)
-        # order matches the scales array's block order exactly
-        W = qm.packed_bn // 2
-        nbt = W // QK
-        xsum = jnp.sum(
-            xb.astype(jnp.float32).reshape(T, np_ // W, QK, nbt), axis=2
-        ).reshape(T, np_ // QK)
-    else:
-        xsum = jnp.sum(xb.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
+    xsum = jnp.sum(xb.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
     corr = jax.lax.dot_general(
         xsum, qm.scales,
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -686,28 +706,15 @@ def _q40_matmul_f32(
 #     values the kernel consumed, so the cancellation is exact in f32).
 
 
-def quantize_q80(x: jax.Array, qm: QuantizedMatrix) -> tuple[jax.Array, jax.Array]:
-    """Quantize activations [T, n_pad] to Q80 in ``qm``'s OWN basis:
-    (int8 values [T, n_pad], f32 scales [T, n_pad/32]) with scale rows in
-    the weight-scales block order (symmetric, scale = max|x|/127 — the
-    reference's Q80 rule, src/quants.cpp:98-122).
-
-    For an interleaved matrix the block of permuted position ``o`` within a
-    window is ``o % nb`` (ops.q40 layout note) and each permuted block holds
-    exactly one ORIGINAL block's elements, so the per-block amax — and the
-    (w, c)-ordered scale rows — coincide with the weight scales' original
-    block order with no gather anywhere."""
+def quantize_q80(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize activations [T, n_pad] to Q80: (int8 values [T, n_pad],
+    f32 scales [T, n_pad/32]), one scale per 32 consecutive elements —
+    the standard basis, matching the weight scales' block order directly
+    (symmetric, scale = max|x|/127 — the reference's Q80 rule,
+    src/quants.cpp:98-122)."""
     T = x.shape[0]
-    np_ = qm.n_padded
+    np_ = x.shape[-1]
     xf = x.astype(jnp.float32)
-    if qm.interleaved:
-        W = qm.packed_bn // 2
-        nbt = W // QK
-        xb = xf.reshape(T, np_ // W, QK, nbt)  # (window, q, block c)
-        amax = jnp.max(jnp.abs(xb), axis=2)  # [T, n_w, nbt]
-        sx = jnp.maximum(amax, 1e-8) / 127.0
-        q = jnp.clip(jnp.round(xb / sx[:, :, None, :]), -127, 127).astype(jnp.int8)
-        return q.reshape(T, np_), sx.reshape(T, np_ // QK)
     xb = xf.reshape(T, np_ // QK, QK)
     amax = jnp.max(jnp.abs(xb), axis=-1)
     sx = jnp.maximum(amax, 1e-8) / 127.0
@@ -715,20 +722,18 @@ def quantize_q80(x: jax.Array, qm: QuantizedMatrix) -> tuple[jax.Array, jax.Arra
     return q.reshape(T, np_), sx
 
 
-def _make_q40_int8_kernel(interleaved: bool):
+def _make_q40_int8_kernel():
     """int8 MXU kernel factory: one (d-tile, n-tile) grid step runs one
     exact int32 block-dot per quant block and folds the scale products into
     the f32 accumulator.
 
     Block layout per half-split window (bn2 = block_n/2 packed rows,
-    nbt = bn2/32 blocks): standard packs group 32 CONSECUTIVE rows per
-    block → reshape [bn2, bd] → [nbt, 32, bd]; interleaved packs put block
-    membership at ``row % nbt`` → reshape [bn2, bd] → [32, nbt, bd]. Both
-    are pure reshapes of the resident tile (the layout restructuring is
-    free), and both feed ONE batched ``dot_general`` with the blocks on the
-    batch axis, 32-deep int8 contraction, and the 128-multiple output tile
-    on the lane axis — int32 accumulation is exact, so block order cannot
-    perturb the result."""
+    nbt = bn2/32 blocks): 32 CONSECUTIVE rows per block → reshape
+    [bn2, bd] → [nbt, 32, bd] — a pure reshape of the resident tile (the
+    layout restructuring is free) feeding ONE batched ``dot_general`` with
+    the blocks on the batch axis, 32-deep int8 contraction, and the
+    128-multiple output tile on the lane axis; int32 accumulation is
+    exact."""
 
     def kernel(xlo_ref, xhi_ref, sxlo_ref, sxhi_ref, qs_ref, slo_ref,
                shi_ref, out_ref, acc_ref):
@@ -748,18 +753,12 @@ def _make_q40_int8_kernel(interleaved: bool):
 
         def half(xq_ref, sx_ref, w_nibbles, sw_ref):
             T = xq_ref.shape[0]
-            if interleaved:
-                # row p belongs to block p % nbt; position o = q*nbt + c
-                xb = xq_ref[:].reshape(T, QK, nbt)
-                wb = w_nibbles.reshape(QK, nbt, bd)
-                contract, batch = ((1,), (0,)), ((2,), (1,))
-            else:
-                xb = xq_ref[:].reshape(T, nbt, QK)
-                wb = w_nibbles.reshape(nbt, QK, bd)
-                contract, batch = ((2,), (1,)), ((1,), (0,))
+            xb = xq_ref[:].reshape(T, nbt, QK)
+            wb = w_nibbles.reshape(nbt, QK, bd)
             # exact per-block int32 accumulation on the MXU int8 path
             P = jax.lax.dot_general(
-                xb, wb, (contract, batch), preferred_element_type=jnp.int32,
+                xb, wb, (((2,), (1,)), ((1,), (0,))),
+                preferred_element_type=jnp.int32,
             )  # [nbt, T, bd]
             # scale-product epilogue: sum_b sx[t,b] * sw[b,d] * P[b,t,d] —
             # [T, nbt, bd]-sized VPU work vs the f32 kernel's per-weight-
@@ -777,35 +776,28 @@ def _make_q40_int8_kernel(interleaved: bool):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
-def _q40_matmul_int8(
-    x: jax.Array,
+def _int8_core(
+    xq: jax.Array,
+    sx: jax.Array,
     qm: QuantizedMatrix,
     block_n: int,
     block_d: int,
     interpret: bool,
 ) -> jax.Array:
-    """The int8 MXU path of :func:`q40_matmul`: Q80-quantize x, run the
-    per-block int8 kernel, subtract the +8 bias as the rank-reduced MXU
-    correction computed from the DEQUANTIZED Q80 sums (exactly the values
-    the kernel consumed, so the f32 cancellation is exact)."""
-    n, d = qm.n, qm.d
-    np_, dp = qm.n_padded, qm.d_padded
-    T = x.shape[0]
-    if x.shape[-1] != np_:
-        if qm.interleaved:
-            # same contract as the f32 kernel: the interleaved basis
-            # intersperses pad features; end-padding cannot fix a mismatch
-            raise ValueError(
-                f"interleaved matmul needs x width {np_}, got {x.shape[-1]}"
-            )
-        x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
-    xq, sx = quantize_q80(x, qm)
+    """The int8 kernel launch + bias epilogue on ALREADY-QUANTIZED Q80
+    activations (xq int8 [T, n_pad], sx f32 [T, n_pad/32]) — shared by the
+    standalone matmul, the fused rmsnorm→Q80 entry, and the fused
+    matmul+all-reduce seam (ops.collectives), so every fusion is
+    arithmetic-identical to the standalone path by construction. Not
+    jitted: callers own the program boundary."""
+    d, dp = qm.d, qm.d_padded
+    np_ = qm.n_padded
+    T = xq.shape[0]
     nj = np_ // block_n
     grid = (dp // block_d, nj)
     nbt = block_n // 2 // QK
     out = pl.pallas_call(
-        _make_q40_int8_kernel(qm.interleaved),
+        _make_q40_int8_kernel(),
         grid=grid,
         in_specs=[
             # Q80 activations: lo/hi halves as two contiguous BlockSpec
@@ -827,14 +819,7 @@ def _q40_matmul_int8(
     )(xq, xq, sx, sx, qm.qs, qm.scales, qm.scales)
     # bias correction on the DEQUANTIZED Q80 block sums: sum_{i in b} of
     # sx[t,b]*xq[t,i] — f32-exact given the int sums are exact
-    if qm.interleaved:
-        W = qm.packed_bn // 2
-        nbt_w = W // QK
-        qsum = jnp.sum(
-            xq.astype(jnp.float32).reshape(T, np_ // W, QK, nbt_w), axis=2
-        ).reshape(T, np_ // QK)
-    else:
-        qsum = jnp.sum(xq.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
+    qsum = jnp.sum(xq.astype(jnp.float32).reshape(T, np_ // QK, QK), axis=-1)
     xsum = sx * qsum
     corr = jax.lax.dot_general(
         xsum, qm.scales,
@@ -843,6 +828,123 @@ def _q40_matmul_int8(
     )
     out = out - 8.0 * corr
     return out[:, :d] if dp != d else out
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "block_d", "interpret"))
+def _q40_matmul_int8(
+    x: jax.Array,
+    qm: QuantizedMatrix,
+    block_n: int,
+    block_d: int,
+    interpret: bool,
+) -> jax.Array:
+    """The int8 MXU path of :func:`q40_matmul`: Q80-quantize x, run the
+    per-block int8 kernel, subtract the +8 bias as the rank-reduced MXU
+    correction computed from the DEQUANTIZED Q80 sums (exactly the values
+    the kernel consumed, so the f32 cancellation is exact)."""
+    np_ = qm.n_padded
+    if x.shape[-1] != np_:
+        x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
+    xq, sx = quantize_q80(x)
+    return _int8_core(xq, sx, qm, block_n, block_d, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused rmsnorm → Q80 quantize → int8 matmul (decode superstep, part a)
+# ---------------------------------------------------------------------------
+#
+# At T=1 the standalone Q80 quantize is one whole extra program per matmul
+# (dispatch overhead ≈ the quantize's own arithmetic), and XLA cannot fuse
+# across the pallas_call boundary. Folding the rmsnorm AND the quantize
+# into the same jitted program as the kernel launch deletes that boundary:
+# rmsnorm → cast → pad → quantize → kernel is ONE program, with the
+# quantize fused into the rmsnorm epilogue by XLA (both are elementwise
+# over [T, n]).
+
+
+def rmsnorm_ref(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMS-normalize over the last axis (f32 math, result in x.dtype) —
+    THE reference rmsnorm: ``models.llama.rmsnorm`` delegates here and the
+    fused entry below inlines these exact ops, so the fused/unfused paths
+    are bit-identical by construction (test-enforced in
+    tests/test_kernel_parity.py)."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (weight.astype(jnp.float32) * (xf * jax.lax.rsqrt(ms + eps))).astype(x.dtype)
+
+
+def _fused_q80_enabled() -> bool:
+    """DLT_FUSED_Q80=0 pins the standalone quantize (A/B arm); default on —
+    the fusion reuses the parity-gated int8 kernel unchanged, so the only
+    behavior change is the number of program boundaries. Accelerator
+    prudence is inherited from :func:`default_q40_path`: the fusion only
+    engages when the path resolves to int8."""
+    env = _os.environ.get("DLT_FUSED_Q80")
+    return env != "0" if env is not None else True
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "block_d", "interpret", "eps")
+)
+def _rmsnorm_q40_matmul_int8(
+    x: jax.Array,
+    weight: jax.Array,
+    qm: QuantizedMatrix,
+    block_n: int,
+    block_d: int,
+    interpret: bool,
+    eps: float,
+) -> jax.Array:
+    # the EXACT unfused op sequence — rmsnorm_ref ops, the bf16 activation
+    # cast models.llama._matmul would apply, end-padding, quantize — in one
+    # program; any arithmetic drift here breaks the fused-vs-unfused
+    # bit-parity gate
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xn = (weight.astype(jnp.float32) * (xf * jax.lax.rsqrt(ms + eps))).astype(x.dtype)
+    xb = xn.astype(jnp.bfloat16)
+    np_ = qm.n_padded
+    if xb.shape[-1] != np_:
+        xb = jnp.pad(xb, ((0, 0), (0, np_ - xb.shape[-1])))
+    xq, sx = quantize_q80(xb)
+    return _int8_core(xq, sx, qm, block_n, block_d, interpret)
+
+
+def rmsnorm_q40_matmul(
+    x: jax.Array,
+    weight: jax.Array,
+    qm: QuantizedMatrix,
+    eps: float = 1e-5,
+    block_n: int = BLOCK_N,
+    block_d: int = BLOCK_D,
+    interpret: bool | None = None,
+    path: str | None = None,
+) -> jax.Array:
+    """y = rmsnorm(x, weight) @ dequant(qm) as ONE fused program when the
+    int8 kernel path is eligible (noted ``mxu_int8_fusedq``); otherwise the
+    unfused reference sequence through :func:`q40_matmul` (which notes its
+    own path). Bit-identical to the unfused sequence either way."""
+    if qm.interleaved:
+        raise ValueError(
+            "interleaved pack: the block-interleaved basis is retired — "
+            "de-interleave at load (q40.deinterleave_input_rows / "
+            "weights.remove_basis_interleave)"
+        )
+    tiles = _resolve_tiles(qm, x.shape[0], block_n, block_d)
+    if path is None:
+        path = default_q40_path()
+    if tiles is None or path != "int8" or not _fused_q80_enabled():
+        # the standalone rmsnorm is its own program ahead of the matmul's —
+        # counted so dllama_kernel_path_total sums to programs-per-step
+        # (the fused path absorbs it; docs/OBSERVABILITY.md)
+        _note_path("rmsnorm", "xla_standalone")
+        xb = rmsnorm_ref(x, weight, eps).astype(jnp.bfloat16)
+        return q40_matmul(xb, qm, block_n, block_d, interpret, path)
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    bn, bd = tiles
+    _note_path("q40_matmul", "mxu_int8_fusedq")
+    return _rmsnorm_q40_matmul_int8(x, weight, qm, bn, bd, interpret, eps)
 
 
 def _shrink_block_d(T: int, block_d: int) -> int:
@@ -894,18 +996,8 @@ def _q40_matmul_fallback(x: jax.Array, qm: QuantizedMatrix) -> jax.Array:
     hi = (qm.qs >> 4).astype(jnp.int8) - 8
     # half-split: low nibbles are rows [0, half), high [half, n_pad)
     w_int = jnp.concatenate([lo, hi], axis=-2)
-    if qm.interleaved:
-        if x.shape[-1] != np_:
-            # same contract as the kernel path: end-padding cannot fix a
-            # basis mismatch (pad features are interspersed, not trailing)
-            raise ValueError(
-                f"interleaved matmul needs x width {np_}, got {x.shape[-1]}"
-            )
-        idx = jnp.asarray(_packed_scale_index(np_, qm.packed_bn // 2))
-        w = w_int.astype(jnp.float32) * qm.scales[idx]
-    else:
-        w = w_int.astype(jnp.float32).reshape(-1, QK, dp) * qm.scales[..., None, :]
-        w = w.reshape(np_, dp)
+    w = w_int.astype(jnp.float32).reshape(-1, QK, dp) * qm.scales[..., None, :]
+    w = w.reshape(np_, dp)
     if x.shape[-1] != np_:
         x = jnp.pad(x, ((0, 0), (0, np_ - x.shape[-1])))
     out = jax.lax.dot_general(

@@ -372,92 +372,10 @@ class TensorParallelForward(TransferProbeMixin):
     # ------------------------------------------------------------------
 
     def shard_params(self, host_params) -> Any:
-        placed = place_params(host_params, self._specs, self.mesh)
-        if self.quantized:
-            placed = self._interleave_tp_basis(placed)
-        return placed
-
-    def _interleave_tp_basis(self, params):
-        """Move the q40 params into the PARTIAL block-interleaved activation
-        basis (the D/residual basis only): qkv/gate_up/wcls input rows and
-        wo/down output columns permute, embedding/rmsnorm vectors and the
-        MoE router follow — all SHAPE-PRESERVING on-device gathers with the
-        output sharding pinned, so the per-shard layout machinery is
-        untouched. ``down`` keeps its standard F input (interleaving the F
-        basis would change per-shard gate_up output shapes), so it stays on
-        the jnp.repeat kernel — qkv/gate_up/wcls (~70% of decode bytes) get
-        the cheap tiled scale broadcast (ops/q40.py layout note; measured
-        +15-18% on those matmuls single-chip). DLT_INTERLEAVE=0 disables."""
-        import os
-
-        from distributed_llama_tpu.ops.q40 import (
-            QuantizedMatrix,
-            _n_padded,
-            interleave_perm,
-            interleave_window,
-        )
-
-        cfg = self.cfg
-        D = cfg.dim
-        W = interleave_window(_n_padded(D))
-        if os.environ.get("DLT_INTERLEAVE") == "0" or W is None or _n_padded(D) != D:
-            return params
-        perm_d = jnp.asarray(interleave_perm(D, W))
-        perm_rows = jnp.asarray(interleave_perm(D // 2, W))  # packed lo-half rows
-
-        def take(arr, perm, axis, spec):
-            ns = NamedSharding(self.mesh, spec)
-            fn = jax.jit(
-                functools.partial(jnp.take, indices=perm, axis=axis),
-                out_shardings=ns,
-            )
-            return fn(arr)
-
-        def rows(qm: QuantizedMatrix, spec) -> QuantizedMatrix:
-            # input-D matrices: packed rows reorder, scales stay (the
-            # permutation maps window-block c to scale row c — see ops/q40)
-            return QuantizedMatrix(
-                take(qm.qs, perm_rows, 0, spec), qm.scales,
-                qm.n_logical, qm.d_logical, interleaved=True, packed_bn=2 * W,
-            )
-
-        def cols(qm: QuantizedMatrix, spec) -> QuantizedMatrix:
-            # output-D matrices: both leaves permute along the d axis
-            return QuantizedMatrix(
-                take(qm.qs, perm_d, 1, spec), take(qm.scales, perm_d, 1, spec),
-                qm.n_logical, qm.d_logical,
-            )
-
-        P_ = P
-        ax = self.axis
-        out = dict(params)
-        out["embedding"] = take(params["embedding"], perm_d, 1, P_(None, None))
-        out["rms_final"] = take(params["rms_final"], perm_d, 0, P_(None))
-        wcls_spec = P_(None, ax) if self.shard_vocab else P_(None, None)
-        out["wcls"] = rows(params["wcls"], wcls_spec)
-        layers = []
-        for lp in params["layers"]:
-            lp = dict(lp)
-            lp["qkv"] = rows(lp["qkv"], P_(None, ax))
-            lp["wo"] = cols(lp["wo"], P_(ax, None))
-            if "experts" in lp:
-                lp["router"] = take(lp["router"], perm_d, 0, P_(None, None))
-                lp["experts"] = [
-                    {
-                        "gate_up": rows(e["gate_up"], P_(None, ax)),
-                        "down": cols(e["down"], P_(ax, None)),
-                    }
-                    for e in lp["experts"]
-                ]
-            else:
-                lp["gate_up"] = rows(lp["gate_up"], P_(None, ax))
-                lp["down"] = cols(lp["down"], P_(ax, None))
-            for k in ("rms_att", "rms_ffn", "rms_moe", "rms_ffn2"):
-                if k in lp:
-                    lp[k] = take(lp[k], perm_d, 0, P_(None))
-            layers.append(lp)
-        out["layers"] = layers
-        return out
+        # (the partial block-interleaved TP basis that used to be applied
+        # here is retired — ops/q40.py legacy section; packs place in the
+        # standard basis and the int8 kernel consumes them directly)
+        return place_params(host_params, self._specs, self.mesh)
 
     def _decode_jitted(self, n_steps: int, temperature: float, topp: float, topk: int):
         # per-instance cache (an lru_cache on the method would pin self and

@@ -343,9 +343,9 @@ def test_fault_registry_matches_shipped_sites():
     assert set(faults.SITES) == {
         "batch.dispatch", "batch.fetch", "batch.row", "engine.forward",
         "engine.decode_dispatch", "engine.fetch", "engine.spec_verify",
-        "engine.paged_attn", "engine.preempt", "engine.sdc",
-        "engine.spill", "replica.crash", "replica.hang", "replica.slow",
-        "tp.transfer", "server.send",
+        "engine.paged_attn", "engine.fused_step", "engine.preempt",
+        "engine.sdc", "engine.spill", "replica.crash", "replica.hang",
+        "replica.slow", "tp.transfer", "server.send",
     }
 
 

@@ -1,19 +1,25 @@
-"""Interpret-mode parity gates for the ISSUE 14 Pallas kernels.
+"""Interpret-mode parity gates for the Pallas kernels (ISSUE 14 + the
+ISSUE 17 decode-megakernel fusions).
 
-Three kernels, three contracts, all runnable on the CPU test substrate
-(conftest pins JAX_PLATFORMS=cpu + an 8-device virtual mesh):
+All runnable on the CPU test substrate (conftest pins JAX_PLATFORMS=cpu +
+an 8-device virtual mesh):
 
 * int8 MXU Q40×Q80 matmul: tolerance vs the f32 kernel and the
   dequantize-then-matmul reference (the int8 path adds ONLY the Q80
-  activation rounding, ~0.5% — far under Q40's own ~3% noise), standard
-  AND block-interleaved bases, plus path-dispatch/telemetry checks.
-* fused paged decode-attention: BIT-parity vs the segmented-scan chain it
-  replaces, across bf16/f32/i8 and bucket shapes — the same machinery
-  that caught bucket-shape drift in PR 10 gates the kernel.
-* ring all-reduce: the ring schedule (ppermute realization — the
-  container's jax cannot interpret remote DMA; the version gate in
-  ops/collectives.py documents this) vs psum under the CPU mesh mocks,
-  including cross-shard byte-identity of the replicated result.
+  activation rounding, ~0.5% — far under Q40's own ~3% noise), plus
+  path-dispatch/telemetry checks.
+* fused rmsnorm→Q80 epilogue (``rmsnorm_q40_matmul``): BIT-parity vs the
+  standalone rmsnorm + int8 matmul it replaces — the fused program inlines
+  the identical op sequence, so any drift is a bug, not tolerance.
+* fused paged decode-attention AND its verify form: BIT-parity vs the
+  segmented-scan chain they replace, across bf16/f32/i8 and bucket shapes,
+  double-buffered and serial DMA schedules, plus the spec-hit ==
+  plain-decode transitivity on the fused path.
+* ring all-reduce + the matmul_all_reduce seam: the ring schedule
+  (ppermute realization — the container's jax cannot interpret remote
+  DMA; the version gate in ops/collectives.py documents this) vs psum
+  under the CPU mesh mocks. The fused matmul+ring kernel is TPU-compiled
+  only; the seam's CPU contract is a clean fallback.
 """
 
 import os
@@ -28,10 +34,11 @@ from distributed_llama_tpu.ops import attention as att
 from distributed_llama_tpu.ops import kv_cache as kvc
 from distributed_llama_tpu.ops.q40 import (
     dequantize_tpu,
-    interleave_input_rows,
     q40_matmul,
     quantize_q40_tpu,
     quantize_q80,
+    rmsnorm_q40_matmul,
+    rmsnorm_ref,
 )
 
 
@@ -55,37 +62,19 @@ class TestInt8Matmul:
         np.testing.assert_allclose(i8 / scale, want / scale, atol=2e-2)
         np.testing.assert_allclose(i8 / scale, f32 / scale, atol=2e-2)
 
-    @pytest.mark.parametrize("T", [1, 8])
-    def test_int8_interleaved_matches_standard(self, T):
-        from distributed_llama_tpu.ops.q40 import _q40_matmul_fallback, interleave_perm
-
-        qm, rng = self._qm(n=1024, d=256, seed=5)
-        qi = interleave_input_rows(qm)
-        assert qi.interleaved
-        x = jnp.asarray(rng.randn(T, qm.n_padded).astype(np.float32))
-        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
-        want = np.asarray(_q40_matmul_fallback(x[:, np.argsort(perm)], qm))
-        got = np.asarray(q40_matmul(x, qi, interpret=True, path="int8"))
-        scale = np.abs(want).max()
-        np.testing.assert_allclose(got / scale, want[:, : qi.d] / scale, atol=2e-2)
-
-    def test_q80_block_scales_follow_weight_scale_order(self):
-        """The interleaved-basis Q80 quantization must produce the SAME
-        scales as the standard basis (permuted blocks hold exactly one
-        original block's elements), so the kernel's scale rows line up
-        with the weight scales in both layouts."""
-        qm, rng = self._qm(n=1024, d=128, seed=7)
-        qi = interleave_input_rows(qm)
-        from distributed_llama_tpu.ops.q40 import interleave_perm
-
-        x = rng.randn(3, qm.n_padded).astype(np.float32)
-        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
-        xq_s, sx_s = quantize_q80(jnp.asarray(x), qm)
-        xq_i, sx_i = quantize_q80(jnp.asarray(x[:, perm]), qi)
-        np.testing.assert_array_equal(np.asarray(sx_s), np.asarray(sx_i))
-        np.testing.assert_array_equal(
-            np.asarray(xq_s)[:, perm], np.asarray(xq_i)
-        )
+    def test_q80_block_quantization_contract(self):
+        """Standard-only Q80: per-32-block int8 values + f32 scales with
+        scale = max|block|/127 (floored) — the layout the int8 kernel's
+        scale-product epilogue and the fused ring kernel both assume."""
+        rng = np.random.RandomState(7)
+        x = rng.randn(3, 1024).astype(np.float32)
+        xq, sx = quantize_q80(jnp.asarray(x))
+        assert xq.dtype == jnp.int8 and sx.dtype == jnp.float32
+        blocks = x.reshape(3, -1, 32)
+        want_s = np.maximum(np.abs(blocks).max(-1) / 127.0, 1e-8)
+        np.testing.assert_allclose(np.asarray(sx), want_s, rtol=1e-6)
+        deq = np.asarray(xq).reshape(3, -1, 32) * np.asarray(sx)[..., None]
+        np.testing.assert_allclose(deq.reshape(3, -1), x, atol=np.abs(x).max() / 120)
 
     def test_dispatch_fallback_small_shapes(self):
         """Matrices too small to tile take the XLA fallback on EVERY path
@@ -120,6 +109,73 @@ class TestInt8Matmul:
             )
             for path in ("mxu_int8", "vpu_f32", "xla_fallback"):
                 assert ctr.labels(kernel="q40_matmul", path=path).value >= 1, path
+        finally:
+            telemetry.reset()
+            telemetry.disable()
+
+
+class TestFusedRmsnormQuantize:
+    """Tentpole (a) of the decode megakernel: the rmsnorm→Q80→int8-matmul
+    fusion deletes one program per matmul at T=1 and must be BIT-identical
+    to the standalone chain — the fused program inlines the exact op
+    sequence (rmsnorm f32 math, the caller's bf16 cast, pad, quantize_q80,
+    the shared _int8_core), so equality is by construction, not
+    tolerance."""
+
+    def _case(self, T, n, d, xdt, seed=3):
+        rng = np.random.RandomState(seed)
+        qm = quantize_q40_tpu(rng.randn(n, d).astype(np.float32) / np.sqrt(n))
+        x = jnp.asarray(rng.randn(T, n).astype(np.float32)).astype(xdt)
+        wgt = jnp.asarray(rng.rand(n).astype(np.float32) + 0.5)
+        return x, wgt, qm
+
+    @pytest.mark.parametrize("xdt", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("T,n,d", [(1, 1024, 256), (8, 512, 128)])
+    def test_bit_parity_vs_standalone(self, xdt, T, n, d):
+        x, wgt, qm = self._case(T, n, d, xdt)
+        fused = rmsnorm_q40_matmul(x, wgt, qm, interpret=True, path="int8")
+        unfused = q40_matmul(
+            rmsnorm_ref(x, wgt).astype(jnp.bfloat16), qm,
+            interpret=True, path="int8",
+        )
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+    def test_flag_off_takes_standalone_arm(self, monkeypatch):
+        """DLT_FUSED_Q80=0 must route through the exact standalone chain —
+        the committed A/B baseline (bench.py --kernels)."""
+        x, wgt, qm = self._case(1, 1024, 256, jnp.float32)
+        want = q40_matmul(
+            rmsnorm_ref(x, wgt).astype(jnp.bfloat16), qm,
+            interpret=True, path="int8",
+        )
+        monkeypatch.setenv("DLT_FUSED_Q80", "0")
+        off = rmsnorm_q40_matmul(x, wgt, qm, interpret=True, path="int8")
+        np.testing.assert_array_equal(np.asarray(off), np.asarray(want))
+
+    def test_untiled_and_f32_paths_fall_back(self):
+        """Shapes the int8 kernel can't tile (or an explicit f32 path)
+        take the standalone chain — dispatch owns eligibility, exactly
+        like q40_matmul's fallback contract."""
+        rng = np.random.RandomState(5)
+        qm = quantize_q40_tpu(rng.randn(64, 96).astype(np.float32))
+        x = jnp.asarray(rng.randn(2, 64).astype(np.float32))
+        wgt = jnp.asarray(rng.rand(64).astype(np.float32) + 0.5)
+        want = q40_matmul(rmsnorm_ref(x, wgt).astype(jnp.bfloat16), qm)
+        got = rmsnorm_q40_matmul(x, wgt, qm)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_kernel_path_counter_fusedq(self):
+        from distributed_llama_tpu import telemetry
+
+        telemetry.enable()
+        try:
+            telemetry.reset()
+            x, wgt, qm = self._case(1, 1024, 256, jnp.float32)
+            rmsnorm_q40_matmul(x, wgt, qm, interpret=True, path="int8")
+            ctr = telemetry.REGISTRY.counter(
+                "dllama_kernel_path_total", labelnames=("kernel", "path")
+            )
+            assert ctr.labels(kernel="q40_matmul", path="mxu_int8_fusedq").value >= 1
         finally:
             telemetry.reset()
             telemetry.disable()
@@ -162,6 +218,93 @@ class TestFusedPagedAttention:
             os.environ.pop("DLT_FUSED_PAGED", None)
         got = att.fused_paged_decode_attention(qg, keys, values, pos, chunk, paged)
         assert bool(jnp.all(got == ref)), float(jnp.max(jnp.abs(got - ref)))
+        # tentpole (c): the double-buffered DMA schedule only reorders copy
+        # issue/wait around unchanged compute — both arms bit-identical
+        ser = att.fused_paged_decode_attention(
+            qg, keys, values, pos, chunk, paged, double_buffer=False
+        )
+        db = att.fused_paged_decode_attention(
+            qg, keys, values, pos, chunk, paged, double_buffer=True
+        )
+        assert bool(jnp.all(ser == ref))
+        assert bool(jnp.all(db == ref))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, "i8"])
+    @pytest.mark.parametrize("B,S,chunk,page", [(4, 64, 16, 4), (2, 96, 24, 8)])
+    def test_verify_bit_parity_and_decode_transitivity(self, dtype, B, S, chunk, page):
+        """Tentpole (d): the fused verify kernel vs the segmented verify
+        scan (bit), both DMA schedules, AND the spec-hit == plain-decode
+        transitivity — query t of a verify window at position pos+t must
+        emit the exact bytes of a plain decode at that position, on the
+        fused path (the contract that makes speculative acceptance
+        decisions identical to the non-speculative stream)."""
+        rng = np.random.RandomState(4)
+        K, M, hd, P_, T = 2, 2, 8, 16, 3
+        qg = jnp.asarray(rng.randn(B, T, K, M, hd).astype(np.float32))
+        keys = _mk_half(rng, (B, S, K, hd), dtype)
+        values = _mk_half(rng, (B, S, K, hd), dtype)
+        pool_k = _mk_half(rng, (P_, page, K, hd), dtype)
+        pool_v = _mk_half(rng, (P_, page, K, hd), dtype)
+        tables = jnp.asarray(rng.randint(0, P_, (B, S // page)).astype(np.int32))
+        matched = jnp.asarray(
+            rng.randint(0, S // page + 1, B).astype(np.int32) * page
+        )
+        # verify windows sit at pos >= matched (the spec-decode invariant)
+        pos = jnp.maximum(
+            matched, jnp.asarray(rng.randint(0, S - T, B), jnp.int32)
+        )
+        paged = (pool_k, pool_v, tables, matched)
+        os.environ["DLT_FUSED_PAGED"] = "0"
+        try:
+            ref = att.batched_verify_attention(
+                qg, keys, values, pos, chunk, paged=paged
+            )
+        finally:
+            os.environ.pop("DLT_FUSED_PAGED", None)
+        for db in (True, False):
+            got = att.fused_paged_verify_attention(
+                qg, keys, values, pos, chunk, paged, double_buffer=db
+            )
+            assert bool(jnp.all(got == ref)), (db, float(jnp.max(jnp.abs(got - ref))))
+        # dispatch routes the paged verify hit path to the fused kernel
+        hit = att.batched_verify_attention(qg, keys, values, pos, chunk, paged=paged)
+        assert bool(jnp.all(hit == ref))
+        # transitivity: verify query t == plain fused decode at pos+t
+        t = 1
+        dec = att.fused_paged_decode_attention(
+            qg[:, t], keys, values, pos + t, chunk, paged
+        )
+        assert bool(jnp.all(ref[:, t] == dec))
+
+    def test_verify_dispatch_counts_fused_path(self):
+        from distributed_llama_tpu import telemetry
+
+        telemetry.enable()
+        try:
+            telemetry.reset()
+            rng = np.random.RandomState(6)
+            B, S, K, M, hd, chunk, page, P_, T = 2, 32, 2, 1, 8, 8, 4, 8, 2
+            qg = jnp.asarray(rng.randn(B, T, K, M, hd).astype(np.float32))
+            keys = _mk_half(rng, (B, S, K, hd), jnp.float32)
+            values = _mk_half(rng, (B, S, K, hd), jnp.float32)
+            paged = (
+                _mk_half(rng, (P_, page, K, hd), jnp.float32),
+                _mk_half(rng, (P_, page, K, hd), jnp.float32),
+                jnp.zeros((B, S // page), jnp.int32),
+                jnp.asarray([8, 0], jnp.int32),
+            )
+            pos = jnp.asarray([20, 5], jnp.int32)
+            att.batched_verify_attention(qg, keys, values, pos, chunk, paged=paged)
+            ctr = telemetry.REGISTRY.counter(
+                "dllama_kernel_path_total", labelnames=("kernel", "path")
+            )
+            assert (
+                ctr.labels(kernel="paged_attention", path="pallas_fused_verify").value
+                >= 1
+            )
+        finally:
+            telemetry.reset()
+            telemetry.disable()
 
     def test_dispatch_takes_fused_path_and_counts_it(self):
         from distributed_llama_tpu import telemetry
@@ -287,3 +430,67 @@ class TestRingAllReduce:
         from distributed_llama_tpu.ops import collectives
 
         assert collectives.default_impl() == "psum"  # CPU test substrate
+
+
+class TestMatmulAllReduceSeam:
+    """Tentpole (b)'s seam: the wo/down matmul+all-reduce entry point
+    (``collectives.matmul_all_reduce``). The fused matmul+ring kernel is
+    TPU-compiled only (the container's jax cannot interpret remote DMA),
+    so the CPU-mesh contract is arm parity through the fallback ladder:
+    the psum arm is exactly the per-shard int8 matmul + psum composition,
+    and ring-schedule arms agree within summation-order tolerance (the
+    same allclose pin as the plain ring all-reduce)."""
+
+    def _mesh(self):
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        return Mesh(mesh_utils.create_device_mesh((8,)), ("tp",))
+
+    def _setup(self):
+        rng = np.random.RandomState(0)
+        n_shard, d, T = 512, 128, 2
+        packs = [
+            quantize_q40_tpu(rng.randn(n_shard, d).astype(np.float32) / 32)
+            for _ in range(8)
+        ]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *packs)
+        xs = jnp.asarray(rng.randn(8, T, n_shard).astype(np.float32))
+        return packs, stacked, xs
+
+    def _run(self, mesh, stacked, xs, impl):
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_llama_tpu.ops import collectives
+
+        def f(x, qm):
+            qm0 = jax.tree.map(lambda a: a[0], qm)
+            return collectives.matmul_all_reduce(x[0], qm0, "tp", impl=impl)
+
+        return np.asarray(jax.jit(collectives.shard_map_compat(
+            f, mesh=mesh, in_specs=(P("tp"), P("tp")), out_specs=P(None, None),
+        ))(xs, stacked))
+
+    def test_seam_arms_agree(self):
+        mesh = self._mesh()
+        packs, stacked, xs = self._setup()
+        # reference: the sum of per-shard standalone int8 matmuls
+        ref = np.sum(
+            [np.asarray(q40_matmul(xs[i], packs[i], path="int8")) for i in range(8)],
+            axis=0,
+        )
+        psum = self._run(mesh, stacked, xs, "psum")
+        ring = self._run(mesh, stacked, xs, "ring")  # fused → clean fallback
+        ring_xla = self._run(mesh, stacked, xs, "ring_xla")
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(psum / scale, ref / scale, atol=1e-5)
+        np.testing.assert_allclose(ring / scale, psum / scale, atol=1e-5)
+        np.testing.assert_allclose(ring_xla / scale, psum / scale, atol=1e-5)
+
+    def test_seam_no_axis_is_plain_matmul(self):
+        packs, _, xs = self._setup()
+        from distributed_llama_tpu.ops import collectives
+
+        got = collectives.matmul_all_reduce(xs[0], packs[0], None)
+        want = q40_matmul(xs[0], packs[0])
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -39,50 +39,60 @@ def test_q40_generate_on_device(tmp_path):
     assert engine.pos == 9
 
 
-def test_q40_interleaved_basis_matches_standard(tmp_path, monkeypatch):
-    """A model with interleave-eligible dims (D multiple of 512, F too) runs
-    the block-interleaved activation basis by default; its logits must match
-    the standard-layout engine (same dequantized weights, different row
-    order — an exact transform; only float association may differ)."""
+def _assert_trees_bit_equal(got, want):
+    import jax
+
+    got_l, want_l = jax.tree.leaves(got), jax.tree.leaves(want)
+    assert len(got_l) == len(want_l)
+    for g, w in zip(got_l, want_l):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_q40_interleaved_checkpoint_migration(tmp_path):
+    """The block-interleaved activation basis is RETIRED: an engine with
+    interleave-eligible dims (the config the basis used to engage on) now
+    loads in the standard basis, and a basis-era params snapshot —
+    synthesized with the retained legacy producer — migrates back through
+    the converter shim BIT-exactly, so old interleaved checkpoints keep
+    loading."""
+    from distributed_llama_tpu.engine import weights as weights_lib
     from distributed_llama_tpu.engine.weights import interleave_eligible
     from distributed_llama_tpu.models.config import config_from_spec
-    from distributed_llama_tpu.ops.q40 import QuantizedMatrix
 
     spec = tiny_spec(
         dim=512, hidden_dim=1024, n_heads=4, n_kv_heads=4, vocab_size=96,
         seq_len=24, weights_float_type=FloatType.Q40,
     )
-    assert interleave_eligible(config_from_spec(spec))
+    cfg = config_from_spec(spec)
+    assert interleave_eligible(cfg)  # the dims the legacy basis targeted
     tensors = random_tensors(spec, seed=3)
     path = str(tmp_path / "il.m")
     write_model_file(path, spec, tensors)
 
-    e_int = InferenceEngine(path, dtype="q40")
-    # the interleave actually engaged (not silently skipped)
-    assert e_int.params["layers"][0]["qkv"].interleaved
-    assert not e_int.params["layers"][0]["wo"].interleaved  # head-basis input
-    got = e_int.forward([1, 5, 9, 13])
+    engine = InferenceEngine(path, dtype="q40")
+    assert not engine.params["layers"][0]["qkv"].interleaved  # retired at load
+    want = engine.forward([1, 5, 9, 13])
+    assert np.all(np.isfinite(np.asarray(want)))
 
-    monkeypatch.setenv("DLT_INTERLEAVE", "0")
-    e_std = InferenceEngine(path, dtype="q40")
-    assert not e_std.params["layers"][0]["qkv"].interleaved
-    want = e_std.forward([1, 5, 9, 13])
-    # tolerance matches the other q40-vs-q40 tests: borderline bf16
-    # roundings flip under any reordering and amplify through
-    # softmax/rmsnorm (the basis change is exact — verified at the
-    # weight level by TestInterleavedBasis)
-    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    # a basis-era snapshot (what an old interleaved checkpoint holds)
+    legacy = weights_lib.apply_basis_interleave(engine.params, cfg)
+    assert legacy["layers"][0]["qkv"].interleaved
+    assert not legacy["layers"][0]["wo"].interleaved  # head-basis input
+    back = weights_lib.remove_basis_interleave(legacy, cfg)
+    assert not back["layers"][0]["qkv"].interleaved
+    _assert_trees_bit_equal(back, engine.params)
 
-    # decode steps agree too (the T=1 hot path)
-    g = e_int.decode_step(7)
-    w = e_std.decode_step(7)
-    np.testing.assert_allclose(g, w, rtol=2e-2, atol=2e-2)
+    # a standard tree passes through the shim untouched (loaders apply it
+    # unconditionally to trees of unknown vintage)
+    assert weights_lib.remove_basis_interleave(engine.params, cfg) is engine.params
 
 
-def test_q40_interleaved_basis_moe(tmp_path, monkeypatch):
-    """MoE expert banks follow the interleaved basis too (per-expert
-    gate_up/down + permuted router rows): parity vs the standard layout."""
+def test_q40_interleaved_checkpoint_migration_moe(tmp_path):
+    """MoE basis-era snapshots (per-expert gate_up/down + permuted router
+    rows) migrate back bit-exactly too."""
+    from distributed_llama_tpu.engine import weights as weights_lib
     from distributed_llama_tpu.formats.model_file import ArchType, HiddenAct
+    from distributed_llama_tpu.models.config import config_from_spec
 
     spec = tiny_spec(
         arch_type=ArchType.MIXTRAL, n_experts=4, n_active_experts=2,
@@ -90,19 +100,19 @@ def test_q40_interleaved_basis_moe(tmp_path, monkeypatch):
         n_kv_heads=4, vocab_size=96, seq_len=48,
         weights_float_type=FloatType.Q40,
     )
+    cfg = config_from_spec(spec)
     tensors = random_tensors(spec, seed=5)
     path = str(tmp_path / "il_moe.m")
     write_model_file(path, spec, tensors)
 
-    prompt = list(np.random.RandomState(2).randint(1, 96, 34))  # bucketed-range T
-    e_int = InferenceEngine(path, dtype="q40")
-    assert e_int.params["layers"][0]["experts"][0]["gate_up"].interleaved
-    got = e_int.forward(prompt)
-    g_step = e_int.decode_step(7)
+    engine = InferenceEngine(path, dtype="q40")
+    assert not engine.params["layers"][0]["experts"][0]["gate_up"].interleaved
+    legacy = weights_lib.apply_basis_interleave(engine.params, cfg)
+    assert legacy["layers"][0]["experts"][0]["gate_up"].interleaved
+    back = weights_lib.remove_basis_interleave(legacy, cfg)
+    _assert_trees_bit_equal(back, engine.params)
 
-    monkeypatch.setenv("DLT_INTERLEAVE", "0")
-    e_std = InferenceEngine(path, dtype="q40")
-    want = e_std.forward(prompt)
-    w_step = e_std.decode_step(7)
-    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
-    np.testing.assert_allclose(g_step, w_step, rtol=2e-2, atol=2e-2)
+    # the migrated engine still decodes (the standard-basis runtime path)
+    prompt = list(np.random.RandomState(2).randint(1, 96, 34))
+    got = engine.forward(prompt)
+    assert np.all(np.isfinite(np.asarray(got)))

@@ -88,11 +88,11 @@ class TestMatmul:
         assert rel < 0.12, rel
 
 
-class TestInterleavedBasis:
-    """The block-interleaved activation basis (ops.q40 layout note): input
-    rows reordered so scale broadcast is a whole-tile tiling. The transform
-    must be exact — kernel, fallback and dequantize must all agree with the
-    standard layout modulo the basis permutation."""
+class TestInterleavedMigration:
+    """The block-interleaved activation basis is RETIRED (ops.q40 legacy
+    section): the runtime is standard-only, the legacy producers survive
+    solely so basis-era snapshots can be synthesized, and the converter
+    shims must invert them bit-exactly."""
 
     def _pair(self, n=512, d=256, seed=5):
         from distributed_llama_tpu.ops.q40 import interleave_input_rows
@@ -104,31 +104,61 @@ class TestInterleavedBasis:
         assert qi.interleaved and qi.packed_bn > 0
         return qm, qi
 
-    def test_dequant_is_row_permutation(self):
-        from distributed_llama_tpu.ops.q40 import interleave_perm
+    def test_retired_basis_rejected_at_every_entry_point(self):
+        """An interleaved pack reaching the runtime is a migration bug, not
+        a layout to dispatch on — dequantize and both matmul entry points
+        must fail loudly instead of silently misreading the row order."""
+        from distributed_llama_tpu.ops.q40 import rmsnorm_q40_matmul
 
         qm, qi = self._pair()
-        std = dequantize_tpu(qm)  # [n, d] logical order
-        il = dequantize_tpu(qi)  # [n_pad, d] interleaved order
-        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
-        np.testing.assert_array_equal(il, std[perm])
+        x = jnp.ones((1, qm.n_padded), jnp.float32)
+        with pytest.raises(ValueError, match="interleav"):
+            dequantize_tpu(qi)
+        with pytest.raises(ValueError, match="interleav"):
+            q40_matmul(x, qi, interpret=True)
+        with pytest.raises(ValueError, match="interleav"):
+            rmsnorm_q40_matmul(
+                x[:, : qm.n], jnp.ones((qm.n,), jnp.float32), qi, interpret=True
+            )
 
-    @pytest.mark.parametrize("T", [1, 8])
-    def test_interleaved_kernel_matches_fallback(self, T):
-        from distributed_llama_tpu.ops.q40 import _q40_matmul_fallback, interleave_perm
+    def test_input_row_round_trip_bit_exact(self):
+        from distributed_llama_tpu.ops.q40 import deinterleave_input_rows
 
         qm, qi = self._pair()
-        rng = np.random.RandomState(7)
-        x = jnp.asarray(rng.randn(T, qm.n_padded).astype(np.float32))
-        # x in the interleaved basis == standard x with permuted features
-        perm = interleave_perm(qm.n_padded, qi.packed_bn // 2)
-        want_std = np.asarray(_q40_matmul_fallback(x[:, np.argsort(perm)], qm))
-        got_fb = np.asarray(_q40_matmul_fallback(x, qi))
-        np.testing.assert_allclose(got_fb, want_std[:, : qi.d], rtol=1e-4, atol=1e-4)
-        got_kernel = np.asarray(q40_matmul(x, qi, interpret=True))
-        scale = np.abs(want_std).max()
-        np.testing.assert_allclose(
-            got_kernel / scale, want_std[:, : qi.d] / scale, atol=2e-2
+        back = deinterleave_input_rows(qi)
+        assert not back.interleaved
+        np.testing.assert_array_equal(np.asarray(back.qs), np.asarray(qm.qs))
+        np.testing.assert_array_equal(np.asarray(back.scales), np.asarray(qm.scales))
+        np.testing.assert_array_equal(
+            np.asarray(dequantize_tpu(back)), np.asarray(dequantize_tpu(qm))
+        )
+
+    def test_output_col_round_trip_bit_exact(self):
+        """gate_up's consumer-basis column permutation (halves=2, padded
+        consumer dims — the hardest case) must invert exactly, restoring
+        the original zero d-padding."""
+        from distributed_llama_tpu.ops.q40 import (
+            deinterleave_output_cols,
+            interleaved_output_cols,
+        )
+
+        rng = np.random.RandomState(9)
+        F = 544  # pads to 1024 -> basis has interspersed pad positions
+        qm = quantize_q40_tpu(rng.randn(512, 2 * F).astype(np.float32) / 16)
+        qo = interleaved_output_cols(qm, F, halves=2)
+        back = deinterleave_output_cols(qo, F, halves=2)
+        assert back.d == qm.d and back.d_padded == qm.d_padded
+        np.testing.assert_array_equal(np.asarray(back.qs), np.asarray(qm.qs))
+        np.testing.assert_array_equal(np.asarray(back.scales), np.asarray(qm.scales))
+
+    def test_vector_round_trip_bit_exact(self):
+        from distributed_llama_tpu.ops.q40 import deinterleave_vector, interleave_vector
+
+        rng = np.random.RandomState(11)
+        v = jnp.asarray(rng.randn(512).astype(np.float32))
+        vi = interleave_vector(v, 512)
+        np.testing.assert_array_equal(
+            np.asarray(deinterleave_vector(vi, 512)), np.asarray(v)
         )
 
     def test_output_cols_pad_positions_are_zero(self):

@@ -125,11 +125,11 @@ def test_q40_tp_divisibility_enforced(tmp_path):
         InferenceEngine(path, dtype="q40", tp=4)
 
 
-def test_tp_partial_interleaved_basis_matches_standard(tmp_path, monkeypatch):
-    """Under TP, eligible models move to the PARTIAL interleaved basis
-    (D-basis rows/columns only; down keeps its standard F input): logits
-    must match the standard layout within bf16-reordering noise, and the
-    flags must show which matrices moved."""
+def test_tp_loads_standard_basis_on_eligible_dims(tmp_path):
+    """The block-interleaved basis (and its TP partial variant) is RETIRED:
+    a TP engine on the dims the basis used to engage on loads every pack
+    in the standard basis — the int8 MXU kernel's scale-product epilogue
+    made the permute moot — and still matches the single-device engine."""
     import numpy as np
 
     from tests.model_utils import random_tensors, tiny_spec, write_model_file
@@ -140,18 +140,16 @@ def test_tp_partial_interleaved_basis_matches_standard(tmp_path, monkeypatch):
         dim=512, hidden_dim=1024, n_heads=4, n_kv_heads=4, vocab_size=96,
         seq_len=24, weights_float_type=FloatType.Q40,
     )
-    path = str(tmp_path / "tp_il.m")
+    path = str(tmp_path / "tp_std.m")
     write_model_file(path, spec, random_tensors(spec, seed=7))
 
-    e_int = InferenceEngine(path, dtype="q40", tp=2)
-    l0 = e_int.params["layers"][0]
-    assert l0["qkv"].interleaved and l0["gate_up"].interleaved
-    assert not l0["down"].interleaved  # F input stays standard under TP
-    assert not l0["wo"].interleaved
-    got = e_int.forward([1, 5, 9, 13])
+    e_tp = InferenceEngine(path, dtype="q40", tp=2)
+    l0 = e_tp.params["layers"][0]
+    for name in ("qkv", "gate_up", "down", "wo"):
+        assert not l0[name].interleaved, name
+    got = e_tp.forward([1, 5, 9, 13])
 
-    monkeypatch.setenv("DLT_INTERLEAVE", "0")
-    e_std = InferenceEngine(path, dtype="q40", tp=2)
-    assert not e_std.params["layers"][0]["qkv"].interleaved
-    want = e_std.forward([1, 5, 9, 13])
+    e_one = InferenceEngine(path, dtype="q40")
+    assert not e_one.params["layers"][0]["qkv"].interleaved
+    want = e_one.forward([1, 5, 9, 13])
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
