@@ -958,6 +958,11 @@ class InferenceEngine:
         # engines that never join a supervised pool pay nothing; ONE HBM
         # pass over the weights when they do (engine/integrity.py)
         self._weights_checksum: str | None = None
+        # which weight VERSION these params are (ISSUE 18): tagged by the
+        # serving layer's versioned factory; None outside a rollout-aware
+        # pool. The blue-green orchestrator verifies a rebuilt replica's
+        # engine against this version's checksum reference
+        self.weights_version: str | None = None
         # the classic single-stream surface's stream is created LAZILY on
         # first use: batched serving (engine.batch) never touches it, and
         # eagerly allocating its KV cache would hold one full cache of HBM

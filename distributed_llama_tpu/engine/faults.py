@@ -93,6 +93,17 @@ Injection sites (the strings passed to :meth:`FaultPlan.fire`):
                     its last estimate instead of dying)
 ``server.send``     raise ``BrokenPipeError`` from the SSE chunk writer
                     (``kind=disconnect``) — models a client disconnect
+``server.rollout``  blue-green rollout chaos (ISSUE 18): fired by the
+                    rollout orchestrator once per replica MOVE, ``row=``
+                    selecting the replica id. ``kind=corrupt`` perturbs
+                    the freshly built new-version engine BEFORE the
+                    checksum gate (the gate trips → automatic rollback);
+                    ``kind=raise`` fails the move at the canary
+                    certification step (a new-version golden mismatch →
+                    rollback); ``kind=delay``/``hang`` widens the
+                    cutover window so a composed ``replica.crash`` can
+                    kill a replica MID-rollout (the supervisor rebuilds
+                    on whatever version the state machine pins)
 ==================  =========================================================
 
 Zero overhead when disabled — the same bind-once trick as telemetry:
@@ -221,6 +232,7 @@ SITES = (
     "replica.slow",
     "tp.transfer",
     "server.send",
+    "server.rollout",
 )
 
 # a "hang" sleeps this long unless the rule sets delay_ms — far beyond any

@@ -58,6 +58,13 @@ FP_PRIME = 16777619
 # tenant names may not start with "_" — server/api.py validates)
 CANARY_TENANT = "_integrity"
 
+# the reserved internal tenant rollout certification probes bill to
+# (ISSUE 18): same contract as the canary tenant — direct lane claim, no
+# admission permit, never a client identity
+ROLLOUT_TENANT = "_rollout"
+
+RESERVED_TENANTS = (CANARY_TENANT, ROLLOUT_TENANT)
+
 
 # ----------------------------------------------------------------------
 # Device-side logit fingerprints (ride the batched decode scan)

@@ -25,7 +25,12 @@ Exit codes: 0 = report produced (all asserted checks passed), 1 = a
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import threading
+import time
+import urllib.error
+import urllib.request
 
 from distributed_llama_tpu.loadgen import report as rep
 from distributed_llama_tpu.loadgen import runner, workload
@@ -192,6 +197,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="self-host cross-replica shadow-vote sampling fraction "
         "(--sdc-shadow-rate on the server)",
     )
+    # live blue-green rollout (ISSUE 18): upgrade the pool mid-window and
+    # gate on zero failed requests — the zero-downtime proof
+    p.add_argument(
+        "--rollout-weights", type=str, default=None, metavar="SPEC",
+        help="fire POST /admin/rollout mid-window. Self-host: 'same' "
+        "writes a second synthetic model with identical bytes under a "
+        "new version id (the consistency check holds across the "
+        "upgrade); an integer writes genuinely different weights from "
+        "that seed. URL mode: a server-side weights path passed "
+        "through in the rollout body",
+    )
+    p.add_argument(
+        "--rollout-at", type=float, default=0.5, metavar="FRACTION",
+        help="when to fire the rollout, as a fraction of the last "
+        "scheduled arrival's offset (default 0.5 = mid-window)",
+    )
+    p.add_argument(
+        "--rollout-version", type=str, default="v1",
+        help="version id the rollout upgrades to",
+    )
     return p
 
 
@@ -246,6 +271,8 @@ def main(argv=None) -> int:
             canary_interval_s=args.canary_interval_s,
             shadow_rate=args.shadow_rate,
             topk=args.topk,
+            rollout_weights=args.rollout_weights,
+            rollout_version=args.rollout_version,
         )
         url = host.url
         print(f"self-hosted server at {url}", file=sys.stderr)
@@ -275,11 +302,33 @@ def main(argv=None) -> int:
                 url, _reindexed(solo), max_inflight=args.max_inflight,
                 timeout_s=args.timeout_s,
             )
+        rollout_thread = None
+        rollout_result: dict = {}
+        if args.rollout_weights is not None:
+            body = {"version": args.rollout_version}
+            if not args.self_host:
+                # URL mode: the server resolves the weights path itself
+                body["weights"] = args.rollout_weights
+            # fire mid-window, scaled to the schedule's actual span, so
+            # in-flight old-version streams straddle the upgrade
+            delay_s = max(0.0, args.rollout_at * schedule[-1].at_s)
+            rollout_thread = threading.Thread(
+                target=_rollout_trigger,
+                args=(url, body, delay_s, args.timeout_s, rollout_result),
+                name="loadgen-rollout", daemon=True,
+            )
         before = rep.scrape_metrics(url)
+        if rollout_thread is not None:
+            rollout_thread.start()
         results, wall_s = runner.run_schedule(
             url, schedule, max_inflight=args.max_inflight,
             timeout_s=args.timeout_s,
         )
+        if rollout_thread is not None:
+            # the POST is synchronous server-side — joining means the
+            # rollout (or its rollback) has fully settled, so the metric
+            # deltas scraped next include every replica move
+            rollout_thread.join(timeout=args.timeout_s)
         after = rep.scrape_metrics(url)
         report = rep.build_report(
             w, schedule, results, wall_s, fingerprint, replay_ok,
@@ -306,6 +355,10 @@ def main(argv=None) -> int:
             report["checks"]["expected_flight"] = rep.check_expected_flight(
                 rep.fetch_flight(url), args.expect_flight
             )
+        if rollout_thread is not None:
+            report["checks"]["rollout"] = rep.check_rollout(
+                rollout_result, results
+            )
         text = rep.dump_report(report, args.out)
         print(text)
         if not replay_ok:
@@ -321,6 +374,7 @@ def main(argv=None) -> int:
         # which is exactly the failure mode under test, not a harness bug
         gate_names = (
             "goodput", "expected_deltas", "expected_zero", "expected_flight",
+            "rollout",
         )
         requested = [report["checks"].get(k) for k in gate_names]
         bad = [
@@ -341,6 +395,35 @@ def main(argv=None) -> int:
     finally:
         if host is not None:
             host.stop()
+
+
+def _rollout_trigger(
+    url: str, body: dict, delay_s: float, timeout_s: float, out: dict
+) -> None:
+    """Sleep to the mid-window instant, then POST /admin/rollout and
+    record (status, response JSON) into ``out``. Runs on its own thread
+    so the open loop keeps firing arrivals while the pool upgrades —
+    which is the entire point of the zero-downtime gate."""
+    time.sleep(delay_s)
+    req = urllib.request.Request(
+        url + "/admin/rollout", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            out["status"] = r.status
+            out["response"] = json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        # 4xx/5xx still carry the server's JSON error payload (e.g. the
+        # RolloutAborted rollback summary) — keep it for the report
+        out["status"] = e.code
+        try:
+            out["response"] = json.loads(e.read().decode() or "{}")
+        except Exception:
+            out["response"] = None
+    except Exception as e:  # connection-level failure
+        out["status"] = None
+        out["error"] = f"{type(e).__name__}: {e}"
 
 
 def _reindexed(subset):

@@ -89,6 +89,14 @@ SERVER_COUNTERS = (
     "dllama_ttft_seconds_count",
     "dllama_tpot_seconds_count",
     "dllama_request_stage_seconds_count",
+    # zero-downtime fleet ops (ISSUE 18): the rollout smoke gates
+    # --expect-delta on replicas moved and --expect-zero on aborts; the
+    # elasticity smoke gates on scale events; the version info gauge
+    # flips 0/1 per version label on rollout completion
+    "dllama_rollout_replicas_moved_total",
+    "dllama_rollout_aborts_total",
+    "dllama_fleet_scale_events_total",
+    "dllama_weights_version",
 )
 
 
@@ -470,6 +478,41 @@ def check_expected_zero(report: dict, names: list[str]) -> dict:
             )
     return {"ok": not violations, "expected_zero": checked,
             "violations": violations}
+
+
+def check_rollout(rollout: dict, results) -> dict:
+    """The zero-downtime gate (ISSUE 18): the mid-window POST
+    /admin/rollout must have returned 200 (every replica moved to the
+    new version, checksum- and canary-certified) AND no request in the
+    window may have failed — arrivals that straddled a drain must have
+    finished on the old version or replayed on a survivor, not errored.
+    429s are admission shedding (workload pressure, not the rollout) and
+    stay out of this gate; the goodput floor judges those."""
+    violations: list[str] = []
+    status = rollout.get("status")
+    if status != 200:
+        detail = rollout.get("error") or rollout.get("response")
+        violations.append(
+            f"POST /admin/rollout returned {status!r} ({detail!r}), "
+            "expected 200"
+        )
+    failed = [
+        {"index": r.index, "tenant": r.tenant, "outcome": r.outcome,
+         "status": r.status, "error_type": r.error_type}
+        for r in results
+        if r.outcome not in ("completed", "rejected_429")
+    ]
+    violations.extend(
+        f"request {f['index']} ({f['tenant']}) failed during the rollout "
+        f"window: {f['outcome']}" for f in failed
+    )
+    return {
+        "ok": not violations,
+        "status": status,
+        "response": rollout.get("response"),
+        "failed_requests": failed,
+        "violations": violations,
+    }
 
 
 def fetch_flight(url: str, timeout_s: float = 10.0) -> dict | None:

@@ -30,6 +30,9 @@ class SelfHost:
     state: object  # ApiState
     server: ThreadingHTTPServer
     plan: object | None = None  # the installed FaultPlan, if any
+    # registered rollout target (ISSUE 18): the version id the runner's
+    # mid-window POST /admin/rollout upgrades to, or None
+    rollout_version: str | None = None
 
     def reset_faults(self) -> None:
         """Rewind the chaos plan's hit/fired counters (same plan object the
@@ -67,6 +70,8 @@ def start_selfhost(
     canary_interval_s: float = 0.0,
     shadow_rate: float = 0.0,
     topk: int = 0,
+    rollout_weights: str | None = None,
+    rollout_version: str = "v1",
 ) -> SelfHost:
     """Build the tiny synthetic model + tokenizer, construct the real
     ApiState (batched decode, prefix cache, weighted-fair admission) and
@@ -162,6 +167,29 @@ def start_selfhost(
             else lambda: InferenceEngine(path, dtype=jnp.float32)
         ),
     )
+    registered_rollout = None
+    if rollout_weights is not None:
+        # blue-green rollout target (ISSUE 18): a SECOND synthetic model
+        # file registered as a new weights version the runner upgrades
+        # to mid-window. "same" writes byte-identical weights (same
+        # seed) under a NEW version id — the full rollout pipeline
+        # (drain, rebuild, checksum gate, per-version golden) runs while
+        # cross-version streams stay bit-identical, so the runner's
+        # consistency assert holds across the upgrade; an integer spec
+        # writes genuinely different weights instead
+        path2 = os.path.join(os.path.dirname(path), "m2.m")
+        seed2 = seed if rollout_weights == "same" else int(rollout_weights)
+        write_synthetic_model(path2, spec, seed=seed2)
+        if group is not None:
+            state.register_weights_version(
+                rollout_version, group.sibling(path2)
+            )
+        else:
+            state.register_weights_version(
+                rollout_version,
+                lambda: InferenceEngine(path2, dtype=jnp.float32),
+            )
+        registered_rollout = rollout_version
     server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(state))
     server.daemon_threads = True
     threading.Thread(
@@ -170,4 +198,5 @@ def start_selfhost(
     return SelfHost(
         url=f"http://127.0.0.1:{server.server_address[1]}",
         state=state, server=server, plan=plan,
+        rollout_version=registered_rollout,
     )

@@ -311,12 +311,14 @@ class PodGroup:
         params: Any,
         cache_dtype=jnp.bfloat16,
         spec=None,
+        dtype=jnp.bfloat16,
     ):
         self.cfg = cfg
         self.backend = backend
         self.params = params
         self.cache_dtype = cache_dtype
         self.spec = spec
+        self.dtype = dtype  # the load dtype, so sibling() loads alike
         self.data = backend.data
         self.model = backend.tp
         self.weight_bytes = tree_weight_bytes(params)
@@ -357,7 +359,35 @@ class PodGroup:
         )
         reader.close()
         params = backend.shard_params(host_params)
-        return cls(cfg, backend, params, cache_dtype=cache_dtype, spec=spec)
+        return cls(
+            cfg, backend, params, cache_dtype=cache_dtype, spec=spec,
+            dtype=dtype,
+        )
+
+    def sibling(self, model_path: str) -> "PodGroup":
+        """A SECOND PodGroup over the SAME mesh/backend with a different
+        weight file placed as a second params tree — the pod's blue-green
+        rollout shape (ISSUE 18): slice engines cut over tree-by-tree via
+        :meth:`slice_engine` on the sibling, compiled programs are reused
+        (same backend, same shapes), and the OLD tree is released by
+        dropping the old group when the last slice moves (the serving
+        layer pops the old version's factory; JAX frees the placed
+        arrays with it). The new file must match the serving config —
+        same architecture, new weights."""
+        from distributed_llama_tpu.engine import weights as weights_lib
+        from distributed_llama_tpu.formats.model_file import ModelFileReader
+
+        reader = ModelFileReader(model_path)
+        host_params = weights_lib.load_params(
+            reader, self.cfg, dtype=self.dtype, tp=self.model, mesh=None
+        )
+        reader.close()
+        params = self.backend.shard_params(host_params)
+        return PodGroup(
+            self.cfg, self.backend, params,
+            cache_dtype=self.cache_dtype, spec=self.spec,
+            dtype=self.dtype,
+        )
 
     def slice_engine(self):
         """A fresh slice engine over the shared backend + params: what a

@@ -202,6 +202,14 @@ class FairAdmission:
         with self._cond:
             return self._free
 
+    def rejected_count(self) -> int:
+        """Total 429s across every tenant — the FleetController's
+        goodput-pressure signal (ISSUE 18): a growing reject rate means
+        demand the SLO never even got to miss, so it counts toward
+        scale-up pressure alongside the live queue depth."""
+        with self._cond:
+            return sum(self.rejected_total.values())
+
     # ------------------------------------------------------------------
     # Acquire / release
     # ------------------------------------------------------------------

@@ -45,6 +45,7 @@ runtime that has an HTTP stack.
 from __future__ import annotations
 
 import argparse
+import copy
 import dataclasses
 import json
 import math
@@ -66,6 +67,7 @@ from distributed_llama_tpu.server.admission import (
     ServerDraining,
     parse_tenants,
 )
+from distributed_llama_tpu.server import fleet
 from distributed_llama_tpu.server.replicas import (
     NoPlaceableReplica,
     Replica,
@@ -206,6 +208,22 @@ class ApiState:
         n_replicas = max(1, int(getattr(args, "replicas", 1) or 1))
         self._lanes = n
         self._engine_factory = engine_factory
+        # versioned engine factories (ISSUE 18): the blue-green rollout
+        # rebuilds replicas through a PER-VERSION zero-arg factory. The
+        # boot factory registers under the boot version id; a rollout
+        # target registers via register_weights_version (selfhost) or
+        # register_weights_path (POST /admin/rollout with a "weights"
+        # path, resolved through make_engine_for_path — installed by
+        # serve(): args-clone + make_engine off the pod, group.sibling
+        # on it, so a pod rollout places a SECOND params tree on the
+        # same mesh/backend)
+        self._boot_version = str(
+            getattr(args, "weights_version", None) or "v0"
+        )
+        self._weights_versions: dict = {}
+        if engine_factory is not None:
+            self._weights_versions[self._boot_version] = engine_factory
+        self.make_engine_for_path = None
         if n_replicas > 1 and engine_factory is None:
             print(
                 "⚠️ replicas reduced to 1: no engine factory to build "
@@ -341,6 +359,7 @@ class ApiState:
             ),
             shared_index=self._shared_index,
             spill_arena=self._spill_arena,
+            weights_version=self._boot_version,
         )
         if self.batch is not None and getattr(args, "preempt", True):
             # priority preemption: a queued high-priority arrival may evict
@@ -393,6 +412,82 @@ class ApiState:
         # fires the server.send site through it (kind=disconnect models a
         # client vanishing mid-stream)
         self.faults = faults.active_plan()
+        # zero-downtime fleet ops (ISSUE 18, server/fleet.py): the
+        # blue-green rollout orchestrator and the SLO elasticity loop
+        # share ONE non-blocking ops lock, so they never mutate the
+        # fleet concurrently. Elasticity is opt-in: with no
+        # --fleet-max-replicas the ceiling IS the boot count, and with
+        # no --fleet-interval-s the controller only ticks manually.
+        self._fleet_lock = threading.Lock()
+        drain_s = getattr(args, "rollout_drain_s", None)
+        self.rollout = fleet.RolloutOrchestrator(
+            self,
+            drain_timeout_s=15.0 if drain_s is None else float(drain_s),
+            ops_lock=self._fleet_lock,
+        )
+        fleet_max = getattr(args, "fleet_max_replicas", None)
+        self.fleet = fleet.FleetController(
+            self,
+            min_replicas=int(
+                getattr(args, "fleet_min_replicas", None) or 1
+            ),
+            max_replicas=(
+                int(fleet_max) if fleet_max is not None
+                else len(self.pool.replicas)
+            ),
+            interval_s=float(
+                getattr(args, "fleet_interval_s", None) or 0.0
+            ),
+            queue_high=getattr(args, "fleet_queue_high", None),
+            ops_lock=self._fleet_lock,
+        )
+        # the info gauge names the pool's current version: exactly one
+        # label at 1 (on_rollout_complete flips it)
+        self.tel.weights_version_info.labels(
+            version=self._boot_version
+        ).set(1)
+
+    # ------------------------------------------------------------------
+    # Versioned weights registry (ISSUE 18, server/fleet.py)
+    # ------------------------------------------------------------------
+
+    def register_weights_version(
+        self, version: str, factory, checksum: str | None = None,
+    ) -> None:
+        """Register a zero-arg engine factory for ``version`` — the
+        rollout target's build path. ``checksum`` (optional) pre-seeds
+        the version's reference; otherwise the first build's pristine
+        load-time checksum records it."""
+        self._weights_versions[str(version)] = factory
+        if checksum is not None:
+            self.pool.register_version(str(version), checksum)
+
+    def has_weights_version(self, version: str) -> bool:
+        return str(version) in self._weights_versions
+
+    def register_weights_path(self, version: str, path: str) -> None:
+        """Register ``version`` from a weight FILE path (the
+        POST /admin/rollout ``"weights"`` field). Resolved through
+        ``make_engine_for_path`` — installed by serve(): an args-clone +
+        make_engine off the pod, ``group.sibling(path)`` on it (the
+        second placed params tree)."""
+        if self.make_engine_for_path is None:
+            raise RuntimeError(
+                "this server cannot load weight files at runtime "
+                "(no path loader installed)"
+            )
+        self.register_weights_version(
+            version, self.make_engine_for_path(str(path))
+        )
+
+    def on_rollout_complete(self, old_version: str, new_version: str) -> None:
+        """Completion hook: drop the OLD version's factory — on the pod
+        that releases the old placed params tree (the factory holds the
+        old PodGroup; the last slice moved) — and flip the info gauge so
+        a scrape names exactly one live pool version."""
+        self._weights_versions.pop(old_version, None)
+        self.tel.weights_version_info.labels(version=old_version).set(0)
+        self.tel.weights_version_info.labels(version=new_version).set(1)
 
     @property
     def slots(self) -> list[StreamSlot]:
@@ -445,13 +540,37 @@ class ApiState:
         restart backoff) replica ``idx``: an engine, its scheduler, and
         its serving lanes. Returns ``(engine, scheduler_or_None, slots)``.
         Slot sampler seeds stay globally distinct across replicas so
-        seedless sampled requests never correlate between lanes."""
+        seedless sampled requests never correlate between lanes.
+
+        Version-aware (ISSUE 18): the build resolves WHICH weights
+        through the pool's rollout state machine (``target_version``) and
+        that version's registered factory, so the orchestrator's cutover
+        and the supervisor's death recovery both converge on the state
+        machine's intent. The fresh engine's PRISTINE load-time checksum
+        registers as the version's reference on first build — recorded
+        before any runtime corruption (injected or real) could land."""
+        pool = getattr(self, "pool", None)
+        version = (
+            pool.target_version(idx) if pool is not None
+            else self._boot_version
+        )
         if engine is None:
-            if self._engine_factory is None:
+            factory = self._weights_versions.get(version)
+            if factory is None:
                 raise RuntimeError(
-                    f"replica {idx} cannot be built: no engine factory"
+                    f"replica {idx} cannot be built: no engine factory "
+                    f"for weights_version {version!r}"
                 )
-            engine = self._engine_factory()
+            engine = factory()
+        try:
+            engine.weights_version = version
+        except AttributeError:
+            pass  # slotted test doubles
+        if pool is not None and version not in pool.weights_reference:
+            try:
+                pool.register_version(version, engine.weights_checksum())
+            except Exception as e:
+                print(f"⚠️ weight checksum unavailable: {e}")
         sched = self._make_scheduler(engine, idx)
         if sched is not None:
             streams = [sched.new_stream() for _ in range(self._lanes)]
@@ -506,10 +625,15 @@ class ApiState:
             # negative (resize removed a dead replica's capacity while its
             # victims still hold permits) — the schema promises >= 0
             "free_slots": max(0, self.admission.free_slots()),
+            # fleet ops (ISSUE 18): the pool's CURRENT weight version and
+            # the live rollout state machine ({"active": False} at rest;
+            # per-replica versions ride each snapshot entry)
+            "weights_version": self.pool.weights_version,
+            "rollout": self.pool.rollout_status(),
             "replicas": self.pool.snapshot(),
         }
 
-    def _canary_probe(self, rep, messages=None):
+    def _canary_probe(self, rep, messages=None, tenant=None):
         """Execute one integrity probe on replica ``rep`` (ISSUE 10): a
         pinned greedy prompt (or ``messages`` — the shadow-vote path)
         through the replica's real batched decode on a directly claimed
@@ -518,8 +642,11 @@ class ApiState:
         real class (queued work preempts it). Returns the
         ``(tokens, fingerprint)`` pair the pool compares against its
         golden, or None when inconclusive — every lane busy, the probe
-        preempted, or the replica lost mid-probe."""
-        slot = self.pool.claim_slot(rep.idx, tenant=integrity.CANARY_TENANT)
+        preempted, or the replica lost mid-probe. ``tenant`` overrides
+        the reserved billing identity (default the canary tenant; the
+        rollout orchestrator certifies under ``_rollout``)."""
+        tenant = tenant or integrity.CANARY_TENANT
+        slot = self.pool.claim_slot(rep.idx, tenant=tenant)
         if slot is None:
             return None
         stream = slot.stream
@@ -530,7 +657,7 @@ class ApiState:
             stream.reset()
             slot.cache.clear()
             stream.prefix_cache_enabled = False
-            stream.tenant = integrity.CANARY_TENANT
+            stream.tenant = tenant
             stream.priority = CANARY_PRIORITY
             msgs = messages or [
                 {"role": "user", "content": self.canary_prompt}
@@ -1178,9 +1305,11 @@ class ApiState:
             not isinstance(tenant, str) or not tenant or len(tenant) > 64
             or tenant.startswith("_")
         ):
-            # leading underscore is reserved for internal tenants (the SDC
-            # canary bills to integrity.CANARY_TENANT): a client must not
-            # be able to impersonate the probe's accounting bucket
+            # leading underscore is reserved for internal tenants
+            # (integrity.RESERVED_TENANTS: the SDC canary bills to
+            # "_integrity", rollout certification probes to "_rollout"):
+            # a client must not be able to impersonate either probe's
+            # accounting bucket
             raise BadRequest(
                 "'tenant' must be a non-empty string of at most 64 chars "
                 "not starting with '_' (reserved)"
@@ -1336,6 +1465,80 @@ def make_handler(state: ApiState):
                 }
             }
 
+        def _admin_rollout(self, rid: str) -> str:
+            """POST /admin/rollout: blue-green weight rollout (ISSUE 18,
+            docs/SERVING.md "Live weight rollout"). Body:
+            ``{"version": "v1"[, "weights": "/path/new.m"]
+            [, "checksum": "<ref>"]}`` — ``weights`` registers the
+            version from a file at runtime (pod: a second placed params
+            tree); without it the version must already be registered.
+            SYNCHRONOUS on this handler thread — the ThreadingHTTPServer
+            keeps serving completions on its siblings throughout (that
+            is the zero-downtime claim under test) and the response
+            carries the outcome: 200 complete, 409 conflict (nothing
+            started), 500 aborted-and-rolled-back (typed, with the
+            final rollout status)."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                length = 0
+            raw = self.rfile.read(max(length, 0)) or b"{}"
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError as e:
+                self._send_json(
+                    400,
+                    self._error_body(
+                        f"malformed JSON: {e}", "invalid_request_error",
+                        rid,
+                    ),
+                    request_id=rid,
+                )
+                return "400"
+            if not isinstance(body, dict):
+                body = {}
+            version = body.get("version")
+            if not isinstance(version, str) or not version:
+                self._send_json(
+                    400,
+                    self._error_body(
+                        "'version' must be a non-empty string",
+                        "invalid_request_error", rid,
+                    ),
+                    request_id=rid,
+                )
+                return "400"
+            try:
+                weights = body.get("weights")
+                if weights:
+                    state.register_weights_path(version, weights)
+                result = state.rollout.run(
+                    version, checksum=body.get("checksum")
+                )
+            except fleet.RolloutConflict as e:
+                self._send_json(
+                    409,
+                    self._error_body(str(e), "rollout_conflict", rid),
+                    request_id=rid,
+                )
+                return "409"
+            except fleet.RolloutAborted as e:
+                payload = self._error_body(str(e), "rollout_aborted", rid)
+                payload["rollout"] = state.pool.rollout_status()
+                self._send_json(500, payload, request_id=rid)
+                return "500"
+            except Exception as e:
+                self._send_json(
+                    500,
+                    self._error_body(
+                        f"{type(e).__name__}: {e}", "server_error", rid
+                    ),
+                    request_id=rid,
+                )
+                return "500"
+            self._send_json(200, result, request_id=rid)
+            return "200"
+
         def do_POST(self):
             # request-duration measurement uses a MONOTONIC clock (Stopwatch
             # wraps perf_counter: a wall-clock step mid-request — NTP, DST —
@@ -1360,6 +1563,8 @@ def make_handler(state: ApiState):
 
         def _do_post_inner(self, rid: str) -> str:
             """Handle one POST; returns the response status for metrics."""
+            if self.path == "/admin/rollout":
+                return self._admin_rollout(rid)
             if self.path != "/v1/chat/completions":
                 self.send_error(404)
                 return "404"
@@ -1627,6 +1832,25 @@ def serve(args) -> None:
     state = ApiState(
         engine, tokenizer, sampler, args, engine_factory=engine_factory
     )
+    # live weight rollout (ISSUE 18): how POST /admin/rollout turns a
+    # weight-file path into a versioned engine factory. Pod: a SECOND
+    # params tree placed on the same mesh/backend (group.sibling — the
+    # group is itself the factory); classic: a flag-clone load of the
+    # new file through make_engine
+    if getattr(args, "pod", None):
+        state.make_engine_for_path = group.sibling
+    else:
+
+        def factory_for_path(path):
+            a = copy.copy(args)
+            a.model = path
+
+            def build():
+                return make_engine(a)[0]
+
+            return build
+
+        state.make_engine_for_path = factory_for_path
     # threaded HTTP front (GET /v1/models and queued POSTs stay responsive);
     # up to --parallel completions run concurrently on their own engine
     # streams, excess requests queue BOUNDEDLY on the slot semaphore
@@ -1710,6 +1934,46 @@ def main(argv=None) -> None:
         "live replicas off-path and compared (cross-replica shadow "
         "voting): divergence marks both suspect and the canary resolves "
         "which is corrupt. 0 disables",
+    )
+    # zero-downtime fleet ops (ISSUE 18, docs/SERVING.md "Live weight
+    # rollout"): blue-green rollout via POST /admin/rollout and
+    # SLO-driven replica elasticity
+    parser.add_argument(
+        "--weights-version", type=str, default=None,
+        help="version id of the BOOT weights (default v0): the key the "
+        "pool's checksum reference and canary golden file under, and "
+        "what /readyz reports per replica — a POST /admin/rollout moves "
+        "the pool to a different registered version",
+    )
+    parser.add_argument(
+        "--rollout-drain-s", type=float, default=15.0,
+        help="per-replica drain cap during a blue-green rollout move; "
+        "past it the lingering requests take the standard failover "
+        "replay path and the move proceeds via the supervisor",
+    )
+    parser.add_argument(
+        "--fleet-min-replicas", type=int, default=1,
+        help="elasticity floor: the FleetController never shrinks the "
+        "pool below this many replicas",
+    )
+    parser.add_argument(
+        "--fleet-max-replicas", type=int, default=None,
+        help="elasticity ceiling: sustained admission-queue pressure "
+        "grows the pool up to this many replicas (each a full engine "
+        "build through the rebuild checksum gate). Default: the boot "
+        "replica count, i.e. elasticity off unless raised",
+    )
+    parser.add_argument(
+        "--fleet-interval-s", type=float, default=0.0,
+        help="FleetController tick period; each tick reads admission "
+        "queue depth + fresh 429s and, after consecutive-tick "
+        "hysteresis, grows or drains+retires one replica. 0 disables "
+        "the background loop",
+    )
+    parser.add_argument(
+        "--fleet-queue-high", type=int, default=None,
+        help="queued-demand threshold that counts as scale-up pressure "
+        "(default: one replica's worth of lanes)",
     )
     parser.add_argument(
         "--batch-decode", action=argparse.BooleanOptionalAction, default=True,

@@ -1,0 +1,590 @@
+"""Zero-downtime fleet operations (ISSUE 18): blue-green weight rollout
+and SLO-driven elasticity on top of the supervised replica pool.
+
+The reference engine must be fully restarted to change weights or
+capacity — the root node owns the model file for the life of the process
+(reference: src/apps/dllama/dllama.cpp — the worker loop binds its
+weights at accept time), so every upgrade is an outage. PRs 9/10/15
+built every primitive a live rollout needs — supervised rebuild, weight
+checksum gates, per-generation canary certification,
+``FairAdmission.resize``, drain, shared-tree pod slices — and this
+module is the ORCHESTRATOR on top: pure policy, zero new mechanism.
+The pool (server/replicas.py) owns cordon/drain/rebuild/grow/retire and
+the per-version integrity anchors; this module sequences them.
+
+:class:`RolloutOrchestrator` — one blue-green move at a time:
+
+1. Pin the slot's target version in the pool's rollout state machine
+   (``set_slot_version`` BEFORE anything else: a replica death at any
+   later point makes the supervisor rebuild on the NEW version — the
+   rollout's intent survives its executor).
+2. Cordon + drain: no new placements land on the replica; in-flight
+   old-version requests finish normally (or, past the drain cap, take
+   the standard failover path: typed ``ReplicaLost`` → requeue →
+   bit-identical replay on a survivor).
+3. Rebuild through the engine factory on the new weights, gated by the
+   NEW version's checksum reference (``weights_reference[version]``).
+4. Canary-certify against the NEW version's golden (the first moved
+   replica records it; every later one must match) on a direct lane
+   claim billed to the reserved ``_rollout`` tenant — certification
+   never contends with client admission.
+5. Uncordon; placement soft-prefers the new version, so traffic shifts
+   as replicas certify.
+
+Any failure — checksum gate, canary mismatch, rebuild timeout — aborts
+the WHOLE rollout with a typed :class:`RolloutAborted`: every moved
+replica is drained and rebuilt back on the old version, the new
+version's checksum reference and canary golden are retired (no stale
+golden left to flap against), and the abort is counted honestly
+(rollback rebuilds never count as moves). A server drain mid-rollout
+aborts WITHOUT rollback rebuilds — the process is exiting; mixed
+versions on the way down are harmless because every version still
+serving has its own golden.
+
+:class:`FleetController` — measured-pressure elasticity: queue depth
+plus admission-reject growth (demand the SLO never even got to miss)
+scale the pool UP through the same build + checksum gate as a rebuild;
+a sustained idle pool scales DOWN by draining and retiring the last
+replica through ``FairAdmission.resize`` (capacity accounting stays
+exact). Consecutive-tick hysteresis (``up_ticks``/``down_ticks``) and
+min/max bounds keep a noisy load from flapping the fleet, and the
+controller never acts while a rollout holds the shared ops lock.
+
+Chaos: the ``server.rollout`` fault site fires once per MOVE with
+``row=`` the replica id — ``kind=corrupt`` perturbs the new engine
+before the checksum gate, ``kind=raise`` fails certification,
+``kind=delay``/``hang`` widens the cutover window for a composed
+``replica.crash``. tests/test_fleet.py drives all three against the
+acceptance contracts in ISSUE.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from distributed_llama_tpu.engine import faults, integrity
+from distributed_llama_tpu.server import replicas
+from distributed_llama_tpu.telemetry import flight
+
+
+class RolloutAborted(RuntimeError):
+    """A blue-green rollout failed its checksum gate, canary
+    certification, or rebuild window and was rolled back (or the server
+    began draining mid-rollout). The pool converges back to the old
+    version; ``dllama_rollout_aborts_total`` counts it."""
+
+
+class RolloutConflict(RuntimeError):
+    """A rollout was refused before it started: another fleet operation
+    holds the ops lock, the target version is unknown/already serving,
+    or the pool is unsupervised (no death-recovery path to converge a
+    mid-rollout crash)."""
+
+
+class _Draining(RuntimeError):
+    """Internal: the server began draining mid-rollout — abort without
+    rollback rebuilds (the process is exiting)."""
+
+
+class RolloutOrchestrator:
+    """Sequences blue-green weight rollouts over ``state.pool``.
+
+    ``state`` is the serving layer's ApiState: it owns the versioned
+    engine factories (``has_weights_version``), the certification probe
+    (``_canary_probe``) and the completion hook
+    (``on_rollout_complete`` — on the pod, dropping the old version's
+    factory releases the old placed params tree). ``ops_lock`` is
+    SHARED with the FleetController: rollout and elasticity never
+    mutate the fleet concurrently."""
+
+    def __init__(
+        self,
+        state,
+        drain_timeout_s: float = 15.0,
+        rebuild_timeout_s: float = 60.0,
+        certify_attempts: int = 50,
+        ops_lock: threading.Lock | None = None,
+    ):
+        self.state = state
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.rebuild_timeout_s = float(rebuild_timeout_s)
+        self.certify_attempts = max(1, int(certify_attempts))
+        self._ops = ops_lock if ops_lock is not None else threading.Lock()
+        # bind-once like every other chaos consumer: the plan is
+        # installed before the server is constructed
+        self._faults = faults.active_plan()
+
+    # ------------------------------------------------------------------
+    # The state machine
+    # ------------------------------------------------------------------
+
+    def run(self, to_version: str, checksum: str | None = None) -> dict:
+        """Roll the whole pool to ``to_version``, one replica at a time.
+        Synchronous: returns the completion summary, or raises
+        :class:`RolloutConflict` (nothing started) /
+        :class:`RolloutAborted` (started, failed, rolled back)."""
+        to_version = str(to_version)
+        if not self._ops.acquire(blocking=False):
+            raise RolloutConflict(
+                "another fleet operation (rollout or scale) is in progress"
+            )
+        try:
+            # _ops IS held: acquired non-blocking above so a concurrent
+            # caller gets a typed 409 instead of queueing behind us.
+            return self._run_locked(to_version, checksum)  # dllama: noqa[LCK-001]
+        finally:
+            self._ops.release()
+
+    def _run_locked(self, to_version: str, checksum: str | None) -> dict:
+        pool = self.state.pool
+        if pool.rollout is not None:
+            raise RolloutConflict("a rollout is already active")
+        if to_version == pool.weights_version:
+            raise RolloutConflict(
+                f"pool already serves weights_version {to_version!r}"
+            )
+        if not self.state.has_weights_version(to_version):
+            raise RolloutConflict(
+                f"unknown weights_version {to_version!r}: register it "
+                "first (selfhost --rollout-weights, or POST /admin/rollout "
+                "with a \"weights\" path)"
+            )
+        if not pool.supervise:
+            raise RolloutConflict(
+                "rollout needs a supervised pool: a replica death "
+                "mid-rollout converges through the restart supervisor"
+            )
+        pool.register_version(to_version, checksum)
+        from_version = pool.weights_version
+        with pool._cond:
+            total = len(pool.replicas)
+            pool.rollout = {
+                "active": True, "from": from_version, "to": to_version,
+                "moved": 0, "total": total,
+            }
+        flight.record(
+            -1, "rollout", phase="start", frm=from_version,
+            to=to_version, total=total,
+        )
+        try:
+            for idx in range(total):
+                if getattr(self.state, "draining", False) or pool._closed:
+                    raise _Draining(
+                        "server draining mid-rollout; aborting without "
+                        "rollback rebuilds"
+                    )
+                mutate = None
+                force_mismatch = False
+                delay_s = 0.0
+                rule = self._faults.fires("server.rollout", row=idx)
+                if rule is not None:
+                    if rule.kind == "corrupt":
+                        mutate = _corrupt_engine
+                    elif rule.kind in ("raise", "nan", "disconnect"):
+                        force_mismatch = True
+                    elif rule.kind in ("delay", "hang"):
+                        delay_s = (
+                            rule.delay_ms or faults.HANG_DEFAULT_MS
+                        ) / 1000.0
+                self._move_one(
+                    idx, to_version, mutate, force_mismatch, delay_s,
+                )
+                with pool._cond:
+                    if pool.rollout is not None:
+                        pool.rollout["moved"] += 1
+                    pool.rollout_moves_total += 1
+                pool.tel.rollout_moved.inc()
+                flight.record(
+                    idx, "rollout", phase="moved", to=to_version,
+                )
+        except _Draining as e:
+            with pool._cond:
+                pool.rollout = None
+                pool.rollout_aborts_total += 1
+            pool.tel.rollout_aborts.inc()
+            flight.record(
+                -1, "rollout", phase="abort", reason="draining",
+                to=to_version,
+            )
+            raise RolloutAborted(str(e)) from e
+        except BaseException as e:
+            self._rollback(from_version, to_version)
+            flight.record(
+                -1, "rollout", phase="abort",
+                reason=f"{type(e).__name__}: {e}", to=to_version,
+            )
+            raise RolloutAborted(
+                f"rollout to {to_version!r} aborted and rolled back to "
+                f"{from_version!r}: {type(e).__name__}: {e}"
+            ) from e
+        # completion: the pool version flips, per-slot pins clear (they
+        # all say to_version now — the pool default), the old version's
+        # integrity anchors leave with its last replica, and the serving
+        # layer drops the old engine factory (on the pod, releasing the
+        # old placed params tree — the last slice moved)
+        with pool._cond:
+            moved = pool.rollout["moved"] if pool.rollout else total
+            pool.weights_version = to_version
+            pool._slot_versions.clear()
+            pool.rollout = None
+        pool.retire_version(from_version)
+        self.state.on_rollout_complete(from_version, to_version)
+        flight.record(
+            -1, "rollout", phase="complete", frm=from_version,
+            to=to_version, moved=moved,
+        )
+        return {
+            "status": "complete",
+            "from": from_version,
+            "to": to_version,
+            "moved": moved,
+            "replicas": len(pool.replicas),
+        }
+
+    def _move_one(
+        self, idx: int, to_version: str, mutate, force_mismatch: bool,
+        delay_s: float,
+    ) -> None:
+        """One replica's cutover: pin → drain → rebuild (checksum-gated)
+        → certify (canary-gated) → uncordon. Raises on any gate."""
+        pool = self.state.pool
+        # FIRST: pin the slot so a death anywhere below rebuilds on the
+        # new version — the state machine's intent outlives this thread
+        pool.set_slot_version(idx, to_version)
+        try:
+            drained = pool.drain_replica(
+                idx, timeout_s=self.drain_timeout_s
+            )
+            if delay_s > 0:
+                # chaos (server.rollout kind=delay/hang): hold the
+                # cutover window open so a composed replica.crash can
+                # land mid-move
+                time.sleep(delay_s)
+            if not drained:
+                # drain-cap escalation: the lingering requests take the
+                # standard failover path (typed ReplicaLost → requeue →
+                # bit-identical replay on a survivor) and the SUPERVISOR
+                # rebuilds — on the pinned new version
+                pool.mark_dead(
+                    idx,
+                    f"rollout drain cap ({self.drain_timeout_s}s) "
+                    "exceeded; escalating to failover",
+                )
+                swapped = False
+            else:
+                swapped = pool.rebuild_replica(idx, mutate=mutate)
+            if not swapped:
+                # lost the swap race to (or delegated it to) the
+                # supervisor — wait for ITS rebuild of the pinned version
+                if not pool.wait_state(
+                    idx, replicas.HEALTHY,
+                    timeout_s=self.rebuild_timeout_s,
+                ):
+                    raise RuntimeError(
+                        f"replica {idx} did not return healthy within "
+                        f"{self.rebuild_timeout_s}s of its cutover"
+                    )
+            rep = pool.replicas[idx]
+            if rep.weights_version != to_version:
+                raise RuntimeError(
+                    f"replica {idx} came back serving "
+                    f"{rep.weights_version!r}, expected {to_version!r}"
+                )
+            if force_mismatch:
+                # chaos (server.rollout kind=raise): the canary-mismatch
+                # model — certification conclusively disagrees
+                raise faults.InjectedFault(
+                    f"injected canary mismatch certifying replica {idx} "
+                    f"on {to_version!r}"
+                )
+            result = None
+            for _ in range(self.certify_attempts):
+                result = self._probe(idx)
+                if result is not None:
+                    break
+                time.sleep(0.05)
+            if result is None:
+                raise RuntimeError(
+                    f"replica {idx} certification inconclusive after "
+                    f"{self.certify_attempts} probe attempts"
+                )
+            if not pool.certify_replica(idx, result):
+                raise RuntimeError(
+                    f"replica {idx} canary-certification MISMATCH "
+                    f"against the {to_version!r} golden"
+                )
+        finally:
+            pool.set_cordon(idx, False)
+
+    def _probe(self, idx: int):
+        """One certification probe on replica ``idx``, billed to the
+        reserved ``_rollout`` tenant. None = inconclusive (lane busy,
+        replica mid-rebuild) — the caller retries."""
+        pool = self.state.pool
+        rep = pool.replicas[idx]
+        try:
+            return self.state._canary_probe(
+                rep, tenant=integrity.ROLLOUT_TENANT
+            )
+        except Exception as e:
+            print(
+                f"⚠️ rollout certification probe on replica {idx} "
+                f"failed: {type(e).__name__}: {e}"
+            )
+            return None
+
+    def _rollback(self, from_version: str, to_version: str) -> None:
+        """Converge the pool back onto ``from_version``: re-pin every
+        slot, drain + rebuild each replica that already moved, retire
+        the failed version's integrity anchors, count the abort. Never
+        raises — rollback is the LAST line; a replica whose rollback
+        rebuild fails is marked dead for the supervisor (whose slot pin
+        now says the OLD version) to recover under backoff."""
+        pool = self.state.pool
+        with pool._cond:
+            idxs = list(range(len(pool.replicas)))
+            for i in idxs:
+                pool._slot_versions[i] = from_version
+        for i in idxs:
+            try:
+                with pool._cond:
+                    if i >= len(pool.replicas):
+                        continue
+                    rep = pool.replicas[i]
+                    needs = (
+                        rep.weights_version == to_version
+                        and rep.state != replicas.DEAD
+                    )
+                if not needs:
+                    # never moved, or dead (the supervisor rebuilds it
+                    # on the re-pinned old version)
+                    pool.set_cordon(i, False)
+                    continue
+                pool.drain_replica(i, timeout_s=self.drain_timeout_s)
+                pool.rebuild_replica(i)
+            except Exception as e:
+                print(
+                    f"⚠️ rollback rebuild of replica {i} failed "
+                    f"({type(e).__name__}: {e}); handing to supervisor"
+                )
+                try:
+                    pool.mark_dead(
+                        i, f"rollback rebuild failed: {e}"
+                    )
+                except Exception:
+                    pass
+            finally:
+                try:
+                    pool.set_cordon(i, False)
+                except Exception:
+                    pass
+        with pool._cond:
+            pool._slot_versions.clear()
+            pool.rollout = None
+            pool.rollout_aborts_total += 1
+        pool.tel.rollout_aborts.inc()
+        # the failed version leaves no trace to flap against: its
+        # checksum reference and canary golden retire with it
+        pool.retire_version(to_version)
+
+
+def _corrupt_engine(engine) -> None:
+    """The server.rollout kind=corrupt payload: deterministically
+    perturb the freshly built new-version engine's weights IN PLACE
+    before the checksum gate sees them — the silent-corruption model
+    the gate exists for (a bit flip in host RAM between load and
+    verify)."""
+    engine.params, _ = integrity.corrupt_params(engine.params)
+
+
+class FleetController:
+    """SLO-driven replica-count elasticity over ``state.pool``.
+
+    One :meth:`tick` reads the measured pressure — live admission queue
+    depth plus NEW 429 rejects since the last tick (demand that never
+    even reached the SLO) — and, after ``up_ticks`` consecutive
+    over-pressure ticks, grows the pool by one replica through the
+    supervisor's build + checksum-gate path; after ``down_ticks``
+    consecutive fully-idle ticks (zero pressure AND the survivors could
+    absorb the last replica's lanes), drains and retires the last
+    replica. Capacity flows through ``FairAdmission.resize`` both ways,
+    so admission accounting stays exact. ``interval_s > 0`` runs the
+    loop on a daemon thread; 0 arms manual ticking (tests)."""
+
+    def __init__(
+        self,
+        state,
+        min_replicas: int = 1,
+        max_replicas: int | None = None,
+        interval_s: float = 0.0,
+        queue_high: int | None = None,
+        up_ticks: int = 2,
+        down_ticks: int = 5,
+        drain_timeout_s: float = 10.0,
+        ops_lock: threading.Lock | None = None,
+    ):
+        self.state = state
+        pool = state.pool
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = (
+            int(max_replicas) if max_replicas is not None
+            else len(pool.replicas)
+        )
+        # default pressure threshold: one replica's worth of lanes
+        # queued means one replica's worth of demand is waiting
+        if queue_high is None:
+            queue_high = (
+                len(pool.replicas[0].slots) if pool.replicas else 1
+            )
+        self.queue_high = max(1, int(queue_high))
+        self.up_ticks = max(1, int(up_ticks))
+        self.down_ticks = max(1, int(down_ticks))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._ops = ops_lock if ops_lock is not None else threading.Lock()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_rejected = 0
+        # plain ledger, readable with telemetry off (mirrors
+        # dllama_fleet_scale_events_total{direction})
+        self.scale_events = {"up": 0, "down": 0}
+        self.interval_s = float(interval_s or 0.0)
+        self._thread: threading.Thread | None = None
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, name="dllama-fleet-controller",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def pressure(self) -> int:
+        """Queue depth + NEW rejects since the last read: rejected
+        demand is pressure the queue depth alone under-reports (a full
+        bounded queue rejects instead of growing)."""
+        adm = self.state.pool.admission
+        if adm is None:
+            return 0
+        rejected = adm.rejected_count()
+        fresh = max(0, rejected - self._last_rejected)
+        self._last_rejected = rejected
+        return adm.waiting() + fresh
+
+    def tick(self) -> str | None:
+        """One elasticity decision. Returns "up"/"down" when the fleet
+        changed, None otherwise. Skips (streaks untouched) when a
+        rollout holds the ops lock — elasticity never fights a
+        rollout."""
+        pool = self.state.pool
+        if (
+            pool._closed
+            or getattr(self.state, "draining", False)
+            or pool.rollout is not None
+        ):
+            self._up_streak = self._down_streak = 0
+            return None
+        if not self._ops.acquire(blocking=False):
+            return None
+        try:
+            # _ops IS held: acquired non-blocking above so elasticity
+            # skips the tick instead of queueing behind a rollout.
+            return self._tick_locked(pool)  # dllama: noqa[LCK-001]
+        finally:
+            self._ops.release()
+
+    def _tick_locked(self, pool) -> str | None:
+        adm = pool.admission
+        if adm is None:
+            return None
+        pressure = self.pressure()
+        n = len(pool.replicas)
+        if pressure >= self.queue_high and n < self.max_replicas:
+            self._down_streak = 0
+            self._up_streak += 1
+            if self._up_streak < self.up_ticks:
+                return None
+            self._up_streak = 0
+            try:
+                idx = pool.grow_replica()
+            except Exception as e:
+                print(
+                    f"⚠️ fleet scale-up failed: {type(e).__name__}: {e}"
+                )
+                return None
+            if idx is None:
+                return None
+            self.scale_events["up"] += 1
+            pool.tel.fleet_scale.labels(direction="up").inc()
+            flight.record(
+                idx, "scale", direction="up",
+                replicas=len(pool.replicas),
+            )
+            return "up"
+        if (
+            pressure == 0
+            and n > self.min_replicas
+            and self._last_idle(pool, adm)
+        ):
+            self._up_streak = 0
+            self._down_streak += 1
+            if self._down_streak < self.down_ticks:
+                return None
+            self._down_streak = 0
+            if not pool.retire_replica(
+                drain_timeout_s=self.drain_timeout_s
+            ):
+                return None
+            self.scale_events["down"] += 1
+            pool.tel.fleet_scale.labels(direction="down").inc()
+            flight.record(
+                len(pool.replicas), "scale", direction="down",
+                replicas=len(pool.replicas),
+            )
+            return "down"
+        # mixed/neutral signals reset BOTH streaks: hysteresis counts
+        # CONSECUTIVE evidence only
+        self._up_streak = 0
+        self._down_streak = 0
+        return None
+
+    @staticmethod
+    def _last_idle(pool, adm) -> bool:
+        """Shrink precondition: the last replica holds no work and the
+        survivors' free lanes could absorb its entire capacity — a
+        retire under this predicate displaces nothing."""
+        with pool._cond:
+            if not pool.replicas:
+                return False
+            last = pool.replicas[-1]
+            if last.active() > 0:
+                return False
+            lanes = len(last.slots)
+        return adm.free_slots() >= lanes
+
+    def _loop(self) -> None:
+        pool = self.state.pool
+        while True:
+            with pool._cond:
+                if pool._closed:
+                    return
+                # monotonic deadline, same as the canary loop: the pool
+                # cond is notified on every slot release, so a bare
+                # wait(timeout=interval) would tick at traffic frequency
+                deadline = time.monotonic() + self.interval_s
+                while not pool._closed:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    pool._cond.wait(timeout=left)
+                if pool._closed:
+                    return
+            try:
+                self.tick()
+            except Exception as e:
+                print(
+                    f"⚠️ fleet controller tick failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+
+    def close(self) -> None:
+        """The controller stops with its pool (pool.close() wakes and
+        exits the loop); nothing else to tear down."""

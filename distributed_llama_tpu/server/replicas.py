@@ -79,11 +79,16 @@ class Replica:
     successor. ``integrity``/``last_canary``/``canary_fails`` are the SDC
     canary's per-replica record (ISSUE 10): the /readyz snapshot reports
     the first two, and consecutive canary mismatches walk the replica
-    down the health ladder."""
+    down the health ladder. ``weights_version`` names the weight version
+    this replica serves (ISSUE 18: a blue-green rollout runs a
+    mixed-version pool mid-flight); ``cordoned`` excludes the replica
+    from NEW placements without any health implication — the rollout's
+    drain-before-rebuild gate."""
 
     __slots__ = (
         "idx", "engine", "scheduler", "slots", "state", "generation",
         "restarts", "integrity", "last_canary", "canary_fails",
+        "weights_version", "cordoned",
     )
 
     def __init__(self, idx: int, engine, scheduler, slots):
@@ -97,6 +102,8 @@ class Replica:
         self.integrity = "unverified"
         self.last_canary: float | None = None
         self.canary_fails = 0
+        self.weights_version = "v0"
+        self.cordoned = False
 
     def active(self) -> int:
         return sum(1 for s in self.slots if s.busy)
@@ -127,6 +134,7 @@ class ReplicaPool:
         restart_seed: int | None = None,
         shared_index=None,
         spill_arena=None,
+        weights_version: str = "v0",
     ):
         from distributed_llama_tpu import telemetry
 
@@ -166,11 +174,14 @@ class ReplicaPool:
         self.last_failover_victims = 0
         # silent-data-corruption detection (ISSUE 10, engine/integrity.py):
         # the canary/shadow/checksum ledger (plain, readable with
-        # telemetry off), the pool-wide canary golden — ONE golden,
-        # because every replica serves the same weights bit-identically
-        # (the replay contract) — and the load-time weight-checksum
-        # reference every rebuilt replica must match before re-entering
-        # placement. The probe itself belongs to the serving layer
+        # telemetry off), plus the PER-VERSION integrity anchors
+        # (ISSUE 18): a blue-green rollout serves two weight versions at
+        # once, so the single pool golden / load-time checksum of PRs
+        # 9-10 become maps keyed by ``weights_version`` — one canary
+        # golden and one checksum reference per LIVE version (within a
+        # version every replica is still bit-identical: the replay
+        # contract), and a retired version's entries leave with it. The
+        # probe itself belongs to the serving layer
         # (ApiState._canary_probe): it needs the tokenizer/template.
         self.sdc_checks_total = 0
         self.sdc_mismatches_total = 0
@@ -178,12 +189,27 @@ class ReplicaPool:
         self.canary_interval_s = 0.0
         self.canary_fail_threshold = 2
         self._canary_thread: threading.Thread | None = None
-        self._canary_golden = None
-        self.weights_reference: str | None = None
+        self.weights_version = str(weights_version)
+        self._canary_goldens: dict[str, object] = {}
+        self.weights_reference: dict[str, str] = {}
+        # the rollout state machine's authority (ISSUE 18): the version
+        # each SLOT should run, overriding the pool version while a
+        # rollout is mid-flight. Every rebuild — the orchestrator's
+        # synchronous cutover AND the supervisor's death recovery —
+        # consults target_version(), so a replica death mid-rollout
+        # converges to the rollout's intent, never the dying replica's.
+        self._slot_versions: dict[int, str] = {}
+        self.rollout: dict | None = None
+        self.rollout_moves_total = 0
+        self.rollout_aborts_total = 0
+        for r in self.replicas:
+            r.weights_version = self.weights_version
         for r in self.replicas:
             if r.engine is not None:
                 try:
-                    self.weights_reference = r.engine.weights_checksum()
+                    self.weights_reference[self.weights_version] = (
+                        r.engine.weights_checksum()
+                    )
                 except Exception as e:  # a reference is an optimization,
                     # never a construction blocker (fake/test replicas)
                     print(f"⚠️ weight checksum unavailable: {e}")
@@ -204,8 +230,8 @@ class ReplicaPool:
             STATE_VALUES[rep.state]
         )
         # a (re)built replica starts integrity-unverified: the next canary
-        # pass re-certifies it against the POOL golden (not a fresh one —
-        # a corrupt-from-rebuild replica must not self-certify)
+        # pass re-certifies it against its VERSION's golden (not a fresh
+        # one — a corrupt-from-rebuild replica must not self-certify)
         rep.integrity = "unverified"
         rep.last_canary = None
         rep.canary_fails = 0
@@ -327,11 +353,16 @@ class ReplicaPool:
 
     def _pick_slot_locked(self, messages, shared=None):
         shared = shared or {}
+        # mid-rollout, placement soft-prefers the TARGET version (below
+        # affinity and routing depth, above raw load): traffic shifts
+        # toward certified upgraded replicas as they come back, without
+        # ever starving the pool when only old-version lanes are free
+        target = self.rollout["to"] if self.rollout else None
         for wanted in (HEALTHY, SUSPECT):
             cands = [
                 (r, s)
                 for r in self.replicas
-                if r.state == wanted
+                if r.state == wanted and not r.cordoned
                 for s in r.slots
                 if not s.busy
             ]
@@ -343,6 +374,8 @@ class ReplicaPool:
                         self.route_score(
                             shared.get(rs[0].idx, 0), rs[0].active()
                         ),
+                        1 if target and rs[0].weights_version == target
+                        else 0,
                         -rs[0].active(),
                         0 if rs[1].cache.items else 1,
                     ),
@@ -460,10 +493,12 @@ class ReplicaPool:
 
     def canary_tick(self) -> int:
         """One canary pass over the live replicas; returns the number of
-        CONCLUSIVE probes. The first conclusive result ever seen becomes
-        the pool golden ("recorded at replica build" — the canary starts
-        with the pool); every later probe compares (tokens, fingerprint)
-        against it. A mismatch walks the replica healthy→suspect, and
+        CONCLUSIVE probes. The first conclusive result ever seen for a
+        WEIGHT VERSION becomes that version's golden ("recorded at
+        replica build" — the canary starts with the pool); every later
+        probe compares (tokens, fingerprint) against its own replica's
+        version golden, so a mixed-version rollout pool runs one golden
+        per live version and never flaps across the divide. A mismatch walks the replica healthy→suspect, and
         ``canary_fail_threshold`` consecutive mismatches declare it DEAD
         **as corrupt** (victims get ReplicaCorrupt — the serving layer
         never splices a replay onto possibly-corrupt sent deltas); a
@@ -507,15 +542,22 @@ class ReplicaPool:
                 rep.last_canary = time.monotonic()
                 self.sdc_checks_total += 1
                 self.tel.sdc_checks.inc()
-                if self._canary_golden is None:
-                    self._canary_golden = result
+                golden = self._canary_goldens.get(rep.weights_version)
+                if golden is None:
+                    self._canary_goldens[rep.weights_version] = result
                     rep.integrity = "ok"
                     rep.canary_fails = 0
-                    flight.record(rep.idx, "canary", verdict="golden_set")
-                elif result == self._canary_golden:
+                    flight.record(
+                        rep.idx, "canary", verdict="golden_set",
+                        version=rep.weights_version,
+                    )
+                elif result == golden:
                     rep.integrity = "ok"
                     rep.canary_fails = 0
-                    flight.record(rep.idx, "canary", verdict="ok")
+                    flight.record(
+                        rep.idx, "canary", verdict="ok",
+                        version=rep.weights_version,
+                    )
                     if rep.state == SUSPECT:
                         # a full pinned greedy round trip through the real
                         # batched path matching the golden is at least as
@@ -530,6 +572,7 @@ class ReplicaPool:
                         rep.idx, "canary", verdict="mismatch",
                         fails=rep.canary_fails,
                         threshold=self.canary_fail_threshold,
+                        version=rep.weights_version,
                     )
                     if rep.canary_fails >= self.canary_fail_threshold:
                         kill_gen = gen
@@ -541,7 +584,8 @@ class ReplicaPool:
                 # scheduler → pool, never the reverse)
                 cause = (
                     f"silent data corruption: {rep.canary_fails} "
-                    "consecutive canary mismatches against the pool golden"
+                    "consecutive canary mismatches against the "
+                    f"{rep.weights_version} golden"
                 )
                 if rep.scheduler is not None:
                     rep.scheduler.mark_lost(cause, corrupt=True)
@@ -597,6 +641,8 @@ class ReplicaPool:
         dump_death = False
         victim_traces: list[str] = []
         with self._cond:
+            if idx >= len(self.replicas):
+                return  # an echo from a retired slot (elastic shrink)
             rep = self.replicas[idx]
             if rep.generation != generation:
                 return  # an echo from a replaced scheduler
@@ -726,8 +772,10 @@ class ReplicaPool:
             print(f"🛑 replica {idx} restart abandoned: {e}")
             return
         with self._cond:
-            rep = self.replicas[idx]
-            if self._closed or rep.generation != generation:
+            rep = (
+                self.replicas[idx] if idx < len(self.replicas) else None
+            )
+            if rep is None or self._closed or rep.generation != generation:
                 dead = scheduler
             else:
                 dead = rep.scheduler
@@ -736,6 +784,10 @@ class ReplicaPool:
                 )
                 rep.generation += 1
                 rep.restarts += 1
+                # death recovery converges to the rollout state machine's
+                # intent: the supervisor rebuilds whatever version THIS
+                # SLOT should run, not whatever the dying replica ran
+                rep.weights_version = self.target_version(idx)
                 self.restarts_total += 1
                 self._set_state_locked(rep, HEALTHY)
                 self._adopt(rep)
@@ -750,29 +802,285 @@ class ReplicaPool:
         """Weight-checksum verification of a rebuilt replica (ISSUE 10):
         the rebuild re-read the weights through the same host RAM / disk /
         cores that may have corrupted the replica in the first place, so
-        it must prove byte-level agreement with the pool's load-time
-        reference BEFORE re-entering placement. A mismatch raises
+        it must prove byte-level agreement with the load-time reference
+        of the VERSION this slot should run (ISSUE 18: per-version map —
+        a rollout cutover verifies against the new version's reference,
+        the supervisor against whatever the state machine says) BEFORE
+        re-entering placement. A mismatch raises
         :class:`integrity.ChecksumMismatch` — the restart loop counts it
         as a failed attempt and retries under backoff."""
-        if engine is None or self.weights_reference is None:
+        version = self.target_version(idx)
+        want = self.weights_reference.get(version)
+        if engine is None or want is None:
             return
         got = integrity.params_checksum(engine.params)
         with self._cond:
             self.sdc_checks_total += 1
         self.tel.sdc_checks.inc()
-        if got != self.weights_reference:
+        if got != want:
             with self._cond:
                 self.sdc_mismatches_total += 1
             self.tel.sdc_mismatches.labels(check="checksum").inc()
             flight.record(
                 idx, "checksum", verdict="mismatch", got=got,
-                want=self.weights_reference,
+                want=want, version=version,
             )
             raise integrity.ChecksumMismatch(
-                f"replica {idx} rebuild checksum {got} != pool reference "
-                f"{self.weights_reference}; refusing to re-enter placement"
+                f"replica {idx} rebuild checksum {got} != {version} "
+                f"reference {want}; refusing to re-enter placement"
             )
-        flight.record(idx, "checksum", verdict="ok")
+        flight.record(idx, "checksum", verdict="ok", version=version)
+
+    # ------------------------------------------------------------------
+    # Rollout + elasticity primitives (ISSUE 18). The pool owns the
+    # MECHANISMS — per-slot target versions, cordon, drain, synchronous
+    # rebuild, grow/retire, per-version checksum references and canary
+    # goldens — while server/fleet.py owns the POLICY (the rollout state
+    # machine and the FleetController loop). Same lock discipline as the
+    # rest of the pool: builds run unlocked, swaps are atomic under
+    # ``_cond`` and generation-guarded, and nothing calls into a
+    # scheduler while holding the pool cond.
+    # ------------------------------------------------------------------
+
+    def target_version(self, idx: int) -> str:
+        """The weight version slot ``idx`` SHOULD run: the rollout state
+        machine's per-slot override when one is set, else the pool
+        version. Every rebuild path — the orchestrated cutover and the
+        supervisor's death recovery alike — builds and verifies this
+        version, so a replica death mid-rollout converges to the
+        rollout's intent, never the dying replica's past."""
+        with self._cond:
+            return self._slot_versions.get(idx, self.weights_version)
+
+    def set_slot_version(self, idx: int, version: str) -> None:
+        """Pin slot ``idx``'s target version (the rollout's first act per
+        move — set BEFORE the drain so a death at any later point
+        rebuilds on the intended version)."""
+        with self._cond:
+            self._slot_versions[idx] = str(version)
+
+    def register_version(self, version: str, checksum: str | None) -> None:
+        """Record a weight version's load-time checksum reference — the
+        rebuild gate for every replica built on that version. ``None``
+        leaves any existing entry alone (a reference is an optimization,
+        never a blocker — fake/test engines have no params)."""
+        if checksum is None:
+            return
+        with self._cond:
+            self.weights_reference[str(version)] = str(checksum)
+
+    def retire_version(self, version: str) -> None:
+        """Drop a version's integrity anchors (checksum reference and
+        canary golden) once no replica serves it: a rolled-back target
+        must not leave a stale golden to flap against later, and a
+        completed rollout's old version leaves with its last replica."""
+        with self._cond:
+            self.weights_reference.pop(version, None)
+            self._canary_goldens.pop(version, None)
+
+    def set_cordon(self, idx: int, cordoned: bool) -> None:
+        """Exclude/include replica ``idx`` from NEW placements. No health
+        implication: cordoned lanes stay claimable for certification
+        probes and keep streaming their in-flight requests to the end."""
+        with self._cond:
+            self.replicas[idx].cordoned = bool(cordoned)
+            self._cond.notify_all()
+
+    def drain_replica(self, idx: int, timeout_s: float = 30.0) -> bool:
+        """Cordon replica ``idx`` and wait for its in-flight requests to
+        finish (or the replica to die — its victims are already in the
+        replay path, which frees the slot either way). Returns False at
+        the cap; the cordon stays on regardless (the caller owns lifting
+        it, and owns escalation on a missed drain)."""
+        self.set_cordon(idx, True)
+        deadline = time.monotonic() + float(timeout_s)
+        with self._cond:
+            while True:
+                rep = self.replicas[idx]
+                if rep.state == DEAD or rep.active() == 0:
+                    return True
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed:
+                    return False
+                self._cond.wait(timeout=left)
+
+    def rebuild_replica(self, idx: int, mutate=None) -> bool:
+        """Synchronously rebuild replica ``idx`` on ``target_version(idx)``
+        through the same factory + checksum gate as the supervisor — the
+        rollout's cutover (death recovery stays with the supervisor's
+        backoff loop). The build runs unlocked; the swap is atomic under
+        the cond and generation-guarded, so racing a concurrent
+        supervisor rebuild is safe: whoever swaps second sees the bumped
+        generation, discards its build, and returns False — the caller
+        re-observes with :meth:`wait_state`. ``mutate`` is the chaos
+        hook (``server.rollout`` ``kind=corrupt``): applied to the fresh
+        engine BEFORE checksum verification, so an injected corruption
+        trips exactly the gate a real one would. Raises on build/verify
+        failure — the caller owns rollback."""
+        with self._cond:
+            gen = self.replicas[idx].generation
+        engine, scheduler, slots = self.build_replica(idx)
+        try:
+            if mutate is not None and engine is not None:
+                mutate(engine)
+            self._verify_rebuild(idx, engine)
+        except BaseException:
+            if scheduler is not None:
+                scheduler.close()
+            raise
+        with self._cond:
+            rep = self.replicas[idx]
+            if self._closed or rep.generation != gen:
+                dead = scheduler
+                swapped = False
+            else:
+                was_dead = rep.state == DEAD
+                dead = rep.scheduler
+                rep.engine, rep.scheduler, rep.slots = (
+                    engine, scheduler, list(slots)
+                )
+                rep.generation += 1
+                rep.weights_version = self.target_version(idx)
+                self._set_state_locked(rep, HEALTHY)
+                self._adopt(rep)
+                if was_dead and self.admission is not None:
+                    # death already resized this capacity out; coming
+                    # back through THIS path (not the supervisor's)
+                    # re-adds it — admission stays exact either way
+                    self.admission.resize(len(rep.slots))
+                swapped = True
+            self._cond.notify_all()
+        if dead is not None:
+            # on a lost race this is OUR scheduler (never adopted); on a
+            # win it is the replaced one — closed outside the cond
+            dead.close()
+        return swapped
+
+    def grow_replica(self):
+        """Append one replica (elastic scale-up) built through the same
+        factory + checksum gate as a rebuild. Joins at the END of the
+        list so existing indices stay dense and stable (the shared
+        index's owner ids, chaos ``row=`` selectors and the flight
+        recorder all key on idx). Returns the new index, or None when
+        the pool closed or a concurrent grow raced us."""
+        with self._cond:
+            if self._closed:
+                return None
+            idx = len(self.replicas)
+        engine, scheduler, slots = self.build_replica(idx)
+        try:
+            self._verify_rebuild(idx, engine)
+        except BaseException:
+            if scheduler is not None:
+                scheduler.close()
+            raise
+        rep = Replica(idx, engine, scheduler, slots)
+        with self._cond:
+            if self._closed or len(self.replicas) != idx:
+                dead = scheduler
+            else:
+                dead = None
+                rep.weights_version = self.target_version(idx)
+                self.replicas.append(rep)
+                self._adopt(rep)
+                if self.admission is not None:
+                    self.admission.resize(len(rep.slots))
+                self._cond.notify_all()
+        if dead is not None:
+            dead.close()
+            return None
+        return idx
+
+    def retire_replica(self, drain_timeout_s: float = 10.0) -> bool:
+        """Drain and remove the LAST replica (elastic scale-down; the
+        last index retires so survivors keep dense idx addressing).
+        Refuses (False) on a 1-replica pool. A missed drain still
+        retires: the leftover in-flight work takes the failover path
+        (typed ReplicaLost → requeue → bit-identical replay on a
+        survivor) — the scale-down contract IS the failover contract,
+        just scheduled instead of suffered."""
+        with self._cond:
+            if self._closed or len(self.replicas) <= 1:
+                return False
+            idx = len(self.replicas) - 1
+        drained = self.drain_replica(idx, timeout_s=drain_timeout_s)
+        with self._cond:
+            if self._closed or len(self.replicas) - 1 != idx:
+                return False  # raced a concurrent grow/retire
+            rep = self.replicas.pop()
+            # orphan any in-flight supervisor rebuild of this slot: its
+            # swap-in is generation-guarded and the slot is gone
+            rep.generation += 1
+            self._slot_versions.pop(idx, None)
+            was_dead = rep.state == DEAD
+            if self.shared_index is not None:
+                self.shared_index.drop_owner(idx)
+            if self.spill_arena is not None:
+                self.spill_arena.drop_owner(idx)
+            if self.admission is not None and not was_dead:
+                # a dead replica's capacity already left at death
+                self.admission.resize(-len(rep.slots))
+            flight.record(
+                idx, "retire", drained=drained, state=rep.state,
+            )
+            self._cond.notify_all()
+        if rep.scheduler is not None:
+            if not drained and not was_dead:
+                # undrained work replays through fair admission — marked
+                # lost OUTSIDE the pool cond (scheduler → pool order);
+                # the pool hook finds the slot gone and returns
+                rep.scheduler.mark_lost(
+                    f"replica {idx} retired (elastic scale-down)"
+                )
+            rep.scheduler.close()
+        return True
+
+    def certify_replica(self, idx: int, result) -> bool:
+        """Compare one conclusive probe ``result`` against the replica's
+        VERSION golden, setting the golden when this is the version's
+        first conclusive probe — the rollout's first upgraded replica
+        records the new version's golden exactly as the boot canary
+        recorded v0's. Counts an SDC check either way; a mismatch counts
+        as one and returns False (the rollout aborts — this gate never
+        walks health states itself)."""
+        with self._cond:
+            rep = self.replicas[idx]
+            version = rep.weights_version
+            rep.last_canary = time.monotonic()
+            self.sdc_checks_total += 1
+            self.tel.sdc_checks.inc()
+            golden = self._canary_goldens.get(version)
+            if golden is None:
+                self._canary_goldens[version] = result
+                rep.integrity = "ok"
+                rep.canary_fails = 0
+                flight.record(
+                    idx, "canary", verdict="golden_set", version=version,
+                )
+                return True
+            if result == golden:
+                rep.integrity = "ok"
+                rep.canary_fails = 0
+                flight.record(
+                    idx, "canary", verdict="ok", version=version,
+                )
+                return True
+            rep.integrity = "mismatch"
+            self.sdc_mismatches_total += 1
+            self.tel.sdc_mismatches.labels(check="canary").inc()
+            flight.record(
+                idx, "canary", verdict="mismatch", version=version,
+            )
+            return False
+
+    def rollout_status(self) -> dict:
+        """The /readyz ``rollout`` field: ``{"active": False}`` at rest,
+        else a copy of the live state machine (active/from/to/moved/
+        total)."""
+        with self._cond:
+            if self.rollout is None:
+                return {"active": False}
+            return dict(self.rollout)
 
     # ------------------------------------------------------------------
     # Introspection (/readyz, tests)
@@ -790,6 +1098,12 @@ class ReplicaPool:
                     "active_rows": r.active(),
                     "slots": len(r.slots),
                     "restarts": r.restarts,
+                    # rollout read (ISSUE 18): which weights this replica
+                    # serves, its rebuild generation, and whether it is
+                    # cordoned out of new placements (drain-in-progress)
+                    "weights_version": r.weights_version,
+                    "generation": r.generation,
+                    "cordoned": r.cordoned,
                     # prefix-cache occupancy (ISSUE 11): device pages held
                     # / pinned and this replica's spill-arena depth. Racy
                     # integer reads of the scheduler's tree on purpose —

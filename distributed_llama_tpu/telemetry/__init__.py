@@ -633,6 +633,38 @@ class ServerInstruments:
             "tenant; sums approximate dllama_http_request_duration_seconds",
             labelnames=("stage", "tenant"),
         )
+        # zero-downtime fleet ops (ISSUE 18, server/fleet.py): the
+        # blue-green rollout and SLO-elasticity ledger
+        self.rollout_moved = counter(
+            "dllama_rollout_replicas_moved_total",
+            "Replicas moved to a new weight version by a blue-green "
+            "rollout (drained, rebuilt on the new weights, checksum-"
+            "verified and canary-certified against the new version's "
+            "golden); rollback rebuilds do NOT count as moves",
+        )
+        self.rollout_aborts = counter(
+            "dllama_rollout_aborts_total",
+            "Rollouts aborted (checksum gate or canary certification "
+            "failed on the new version, or the server began draining "
+            "mid-rollout); each abort rolls every moved replica back to "
+            "the old version and raises a typed RolloutAborted",
+        )
+        self.fleet_scale = counter(
+            "dllama_fleet_scale_events_total",
+            "Elastic replica-count changes applied by the FleetController "
+            "(up = grew one replica under sustained queue pressure, "
+            "down = drained and retired one idle replica); hysteresis "
+            "keeps this counter quiet on a stable fleet",
+            labelnames=("direction",),
+        )
+        self.weights_version_info = gauge(
+            "dllama_weights_version",
+            "Info gauge: 1 on the label of the pool's CURRENT weight "
+            "version (the old version's label drops to 0 when a rollout "
+            "completes, so a scrape always names exactly one live pool "
+            "version; mid-rollout per-replica versions are in /readyz)",
+            labelnames=("version",),
+        )
 
 
 class SamplerInstruments:

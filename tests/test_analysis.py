@@ -345,7 +345,7 @@ def test_fault_registry_matches_shipped_sites():
         "engine.decode_dispatch", "engine.fetch", "engine.spec_verify",
         "engine.paged_attn", "engine.fused_step", "engine.preempt",
         "engine.sdc", "engine.spill", "replica.crash", "replica.hang",
-        "replica.slow", "tp.transfer", "server.send",
+        "replica.slow", "tp.transfer", "server.send", "server.rollout",
     }
 
 

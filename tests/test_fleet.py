@@ -1,0 +1,577 @@
+"""Zero-downtime fleet ops (ISSUE 18): blue-green weight rollout and
+SLO-driven elasticity.
+
+Three layers, mirroring the subsystem:
+
+* Pool fleet primitives — per-slot version pins, cordon/drain, the
+  synchronous rebuild/grow/retire paths and their exact
+  ``FairAdmission.resize`` accounting, per-version canary certification
+  (deterministic: fake replicas, no engines).
+* :class:`RolloutOrchestrator` / :class:`FleetController` units over a
+  fake pool — conflict preconditions, the full happy-path state machine,
+  injected-mismatch rollback, and consecutive-tick hysteresis.
+* Serving-level acceptance over real HTTP — the ISSUE 18 criteria: a
+  mid-stream upgrade of a 2-replica pool with ZERO failed requests and
+  old-version streams bit-identical to an un-upgraded baseline; a
+  ``server.rollout kind=corrupt`` build tripping the checksum gate into
+  a typed, fully-converged rollback with no golden flap; a server drain
+  landing mid-rollout (the SIGTERM-during-replica-2-of-3 window) ending
+  with permits home and clean streams; and real-build elasticity.
+
+Everything runs on tiny seeded synthetic models under JAX_PLATFORMS=cpu
+(tier-1 safe); the ``chaos`` marker tags the HTTP classes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_llama_tpu.engine import InferenceEngine, faults, integrity
+from distributed_llama_tpu.server import fleet
+from distributed_llama_tpu.server.admission import FairAdmission
+
+from tests.test_fair_sched import SseStream
+from tests.test_faults import get, post_raw, serve_state
+from tests.test_replicas import _SLOW, _one_long_prompt, fake_pool, \
+    make_replica_state
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def post_admin(url, body: dict, timeout=180):
+    """POST /admin/rollout → (status, parsed JSON body)."""
+    req = urllib.request.Request(
+        url + "/admin/rollout", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class FakeFleetState:
+    """The orchestrator/controller's ApiState surface, minus HTTP: a
+    pool, a versioned-factory registry, and a deterministic
+    certification probe."""
+
+    def __init__(self, pool, versions=(), probe=None):
+        self.pool = pool
+        self.draining = False
+        self._versions = set(versions)
+        self.completed = []
+        self._probe = probe or (lambda rep: ("tokens", "fingerprint"))
+
+    def has_weights_version(self, version):
+        return version in self._versions
+
+    def _canary_probe(self, rep, messages=None, tenant=None):
+        # certification must bill to the reserved rollout tenant, never
+        # the client-visible admission path
+        assert tenant == integrity.ROLLOUT_TENANT
+        return self._probe(rep)
+
+    def on_rollout_complete(self, old, new):
+        self.completed.append((old, new))
+
+
+# ----------------------------------------------------------------------
+# Pool fleet primitives (fake replicas: no engines, deterministic)
+# ----------------------------------------------------------------------
+
+
+class TestPoolFleetPrimitives:
+    def test_slot_version_pin_overrides_pool_default(self):
+        pool = fake_pool()
+        assert pool.weights_version == "v0"
+        assert pool.target_version(0) == "v0"
+        pool.set_slot_version(0, "v1")
+        assert pool.target_version(0) == "v1"
+        assert pool.target_version(1) == "v0"  # unpinned slots follow the pool
+        pool._slot_versions.clear()
+        assert pool.target_version(0) == "v0"
+        pool.close()
+
+    def test_cordon_excludes_from_placement_but_not_claims(self):
+        pool = fake_pool()
+        pool.set_cordon(0, True)
+        for _ in range(3):
+            slot = pool.place([{"role": "user", "content": "x"}])
+            assert slot in pool.replicas[1].slots
+            slot.busy = False
+        # cordoned lanes stay claimable — certification probes need them
+        assert pool.claim_slot(0) is not None
+        pool.close()
+
+    def test_drain_replica_caps_then_succeeds(self):
+        pool = fake_pool()
+        pool.replicas[0].slots[0].busy = True
+        assert pool.drain_replica(0, timeout_s=0.05) is False
+        assert pool.replicas[0].cordoned  # the cordon stays on at the cap
+        pool.replicas[0].slots[0].busy = False
+        assert pool.drain_replica(0, timeout_s=1.0) is True
+        pool.close()
+
+    def test_grow_and_retire_keep_admission_exact(self):
+        adm = FairAdmission(2, queue_limit=8)
+        pool = fake_pool(n_replicas=1, lanes=2, admission=adm)
+        assert pool.grow_replica() == 1
+        assert len(pool.replicas) == 2 and adm.n_slots == 4
+        assert pool.replicas[1].weights_version == "v0"
+        assert pool.retire_replica(drain_timeout_s=1.0) is True
+        assert len(pool.replicas) == 1 and adm.n_slots == 2
+        # a 1-replica pool refuses to retire its last replica
+        assert pool.retire_replica(drain_timeout_s=1.0) is False
+        assert adm.n_slots == 2
+        pool.close()
+
+    def test_certify_replica_per_version_golden(self):
+        pool = fake_pool()
+        pool.replicas[1].weights_version = "v1"
+        # first conclusive probe per VERSION records that version's golden
+        assert pool.certify_replica(0, ("a", 1)) is True
+        assert pool.certify_replica(1, ("b", 2)) is True
+        assert pool._canary_goldens == {"v0": ("a", 1), "v1": ("b", 2)}
+        # later probes compare against their own version's golden only
+        assert pool.certify_replica(0, ("a", 1)) is True
+        assert pool.certify_replica(1, ("a", 1)) is False
+        assert pool.sdc_mismatches_total == 1
+        pool.retire_version("v1")
+        assert set(pool._canary_goldens) == {"v0"}
+        pool.close()
+
+
+# ----------------------------------------------------------------------
+# Orchestrator units (fake pool)
+# ----------------------------------------------------------------------
+
+
+class TestRolloutOrchestrator:
+    def test_preconditions_raise_typed_conflicts(self):
+        pool = fake_pool(supervise=True)
+        fstate = FakeFleetState(pool, versions=("v1",))
+        orch = fleet.RolloutOrchestrator(fstate)
+        with pytest.raises(fleet.RolloutConflict, match="already serves"):
+            orch.run("v0")
+        with pytest.raises(fleet.RolloutConflict, match="unknown"):
+            orch.run("v9")
+        lock = threading.Lock()
+        held = fleet.RolloutOrchestrator(fstate, ops_lock=lock)
+        with lock:
+            with pytest.raises(fleet.RolloutConflict, match="in progress"):
+                held.run("v1")
+        pool.close()
+        unsup = fake_pool(supervise=False)
+        with pytest.raises(fleet.RolloutConflict, match="supervised"):
+            fleet.RolloutOrchestrator(
+                FakeFleetState(unsup, versions=("v1",))
+            ).run("v1")
+        unsup.close()
+
+    def test_happy_path_moves_all_and_flips_version(self):
+        pool = fake_pool(supervise=True)
+        fstate = FakeFleetState(pool, versions=("v1",))
+        result = fleet.RolloutOrchestrator(fstate).run("v1")
+        assert result["status"] == "complete" and result["moved"] == 2
+        assert pool.weights_version == "v1"
+        assert [r.weights_version for r in pool.replicas] == ["v1", "v1"]
+        assert not pool._slot_versions  # pins cleared at completion
+        assert pool.rollout_status() == {"active": False}
+        assert pool.rollout_moves_total == 2
+        assert pool.rollout_aborts_total == 0
+        # the old version's integrity anchors left with its last replica,
+        # and the serving layer got its completion hook
+        assert set(pool._canary_goldens) == {"v1"}
+        assert fstate.completed == [("v0", "v1")]
+        assert not any(r.cordoned for r in pool.replicas)
+        pool.close()
+
+    def test_second_replica_golden_mismatch_rolls_back(self):
+        pool = fake_pool(supervise=True)
+        # replica 0's probe records v1's golden; replica 1 conclusively
+        # disagrees — the canary-certification gate must abort the rollout
+        fstate = FakeFleetState(
+            pool, versions=("v1",), probe=lambda rep: ("fp", rep.idx)
+        )
+        with pytest.raises(fleet.RolloutAborted, match="MISMATCH"):
+            fleet.RolloutOrchestrator(fstate).run("v1")
+        assert pool.weights_version == "v0"
+        assert [r.weights_version for r in pool.replicas] == ["v0", "v0"]
+        assert pool.rollout_aborts_total == 1
+        assert pool.rollout_moves_total == 1  # only replica 0 ever moved
+        assert "v1" not in pool._canary_goldens  # no stale golden to flap
+        assert not pool._slot_versions and not any(
+            r.cordoned for r in pool.replicas
+        )
+        assert fstate.completed == []  # the old factory was never dropped
+        pool.close()
+
+    def test_injected_certification_fault_rolls_back(self):
+        faults.install(
+            faults.parse("server.rollout:kind=raise,row=1,count=1")
+        )
+        pool = fake_pool(supervise=True)
+        fstate = FakeFleetState(pool, versions=("v1",))
+        with pytest.raises(fleet.RolloutAborted):
+            fleet.RolloutOrchestrator(fstate).run("v1")
+        assert [r.weights_version for r in pool.replicas] == ["v0", "v0"]
+        assert pool.rollout_aborts_total == 1
+        assert pool.rollout_status() == {"active": False}
+        # the pool converged: a retry with the fault spent completes
+        result = fleet.RolloutOrchestrator(fstate).run("v1")
+        assert result["status"] == "complete"
+        assert pool.weights_version == "v1"
+        pool.close()
+
+
+# ----------------------------------------------------------------------
+# FleetController units (fake pool + real FairAdmission)
+# ----------------------------------------------------------------------
+
+
+class TestFleetController:
+    def _setup(self, **kw):
+        adm = FairAdmission(2, queue_limit=16)
+        pool = fake_pool(n_replicas=1, lanes=2, admission=adm)
+        fstate = FakeFleetState(pool)
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 3)
+        kw.setdefault("queue_high", 2)
+        kw.setdefault("up_ticks", 2)
+        kw.setdefault("down_ticks", 2)
+        ctl = fleet.FleetController(fstate, **kw)
+        return pool, adm, ctl
+
+    @staticmethod
+    def _push(adm, n=2):
+        # rejected demand IS pressure: a full bounded queue 429s instead
+        # of growing, so queue depth alone under-reports it
+        adm.rejected_total["load"] = adm.rejected_total.get("load", 0) + n
+
+    def test_grow_needs_consecutive_pressure_ticks(self):
+        pool, adm, ctl = self._setup()
+        self._push(adm)
+        assert ctl.tick() is None  # streak 1 of 2
+        self._push(adm)
+        assert ctl.tick() == "up"
+        assert len(pool.replicas) == 2 and adm.n_slots == 4
+        assert ctl.scale_events == {"up": 1, "down": 0}
+        pool.close()
+
+    def test_interrupted_pressure_resets_the_streak(self):
+        pool, adm, ctl = self._setup(down_ticks=5)
+        self._push(adm)
+        assert ctl.tick() is None
+        assert ctl.tick() is None  # idle tick: up streak resets
+        self._push(adm)
+        assert ctl.tick() is None  # back to streak 1 — no flap
+        assert len(pool.replicas) == 1
+        pool.close()
+
+    def test_sustained_idle_shrinks_to_min_and_stops(self):
+        pool, adm, ctl = self._setup()
+        for _ in range(2):
+            self._push(adm)
+            ctl.tick()
+        self._push(adm)
+        self._push(adm)
+        # hysteresis counts fresh rejects per tick; two more pressure
+        # ticks grow to the max of 3
+        self._push(adm)
+        assert ctl.tick() is None
+        self._push(adm)
+        assert ctl.tick() == "up"
+        assert len(pool.replicas) == 3 and adm.n_slots == 6
+        assert ctl.tick() is None  # idle streak 1 of 2
+        assert ctl.tick() == "down"
+        assert len(pool.replicas) == 2 and adm.n_slots == 4
+        assert ctl.tick() is None
+        assert ctl.tick() == "down"
+        assert len(pool.replicas) == 1 and adm.n_slots == 2
+        # min bound: a fully idle 1-replica pool never shrinks further
+        for _ in range(4):
+            assert ctl.tick() is None
+        assert len(pool.replicas) == 1
+        assert ctl.scale_events == {"up": 2, "down": 2}
+        pool.close()
+
+    def test_controller_defers_to_an_active_rollout(self):
+        pool, adm, ctl = self._setup(up_ticks=1)
+        with pool._cond:
+            pool.rollout = {"active": True, "from": "v0", "to": "v1",
+                            "moved": 0, "total": 1}
+        self._push(adm)
+        assert ctl.tick() is None  # elasticity never fights a rollout
+        assert len(pool.replicas) == 1 and ctl._up_streak == 0
+        pool.close()
+
+
+# ----------------------------------------------------------------------
+# Serving-level acceptance over real HTTP
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestRolloutServing:
+    def test_acceptance_live_rollout_zero_failures_bit_identical(
+        self, tmp_path
+    ):
+        """The ISSUE 18 acceptance test: a 2-replica pool upgraded
+        mid-window — (a) zero failed requests, (b) in-flight old-version
+        streams bit-identical to an un-upgraded baseline, (c) both
+        replicas re-certified against the NEW version's golden, (d)
+        ``weights_reference`` holds exactly the new version after, and
+        the /readyz schema reports it all."""
+        clean = make_replica_state(tmp_path, "base", replicas=2, parallel=2)
+        url0, server0 = serve_state(clean)
+        try:
+            prompt, baseline = _one_long_prompt(url0)
+        finally:
+            server0.shutdown()
+            clean.pool.close()
+
+        # slow decode on every replica: the upgrade lands while both
+        # streams are deep mid-decode (a delay injects no corruption)
+        faults.install(faults.parse(_SLOW))
+        state = make_replica_state(
+            tmp_path, "ro", replicas=2, parallel=2, sdc_canary_tokens=4,
+        )
+        # "new" weights: byte-identical bytes under a NEW version id (the
+        # loadgen --rollout-weights same model) — the full pipeline runs
+        # while cross-version streams stay bit-comparable
+        model = str(tmp_path / "ro.m")
+        state.register_weights_version(
+            "v1", lambda: InferenceEngine(model, dtype=jnp.float32)
+        )
+        url, server = serve_state(state)
+        try:
+            # reserved internal tenants are rejected up front: clients
+            # must not impersonate either probe's accounting bucket
+            for reserved in integrity.RESERVED_TENANTS:
+                status, _, body = post_raw(
+                    url, {"messages": [{"role": "user", "content": "x"}],
+                          "tenant": reserved},
+                )
+                assert status == 400, reserved
+            body = {"messages": [{"role": "user", "content": prompt}],
+                    "max_tokens": 96}
+            s1, s2 = SseStream(url, body), SseStream(url, body)
+            first1, first2 = s1.read_first_delta(), s2.read_first_delta()
+            res = {}
+            t = threading.Thread(
+                target=lambda: res.update(r=post_admin(url, {"version": "v1"}))
+            )
+            t.start()
+            got1 = first1 + s1.read_rest()
+            got2 = first2 + s2.read_rest()
+            t.join(timeout=180)
+            assert not t.is_alive()
+            status, resp = res["r"]
+            assert status == 200, resp
+            assert resp["status"] == "complete" and resp["moved"] == 2
+            # the straddling old-version streams ended bit-identically
+            assert got1 == baseline and got2 == baseline
+            assert s1.error_type is None and s2.error_type is None
+            pool = state.pool
+            assert pool.weights_version == "v1"
+            assert [r.weights_version for r in pool.replicas] == ["v1", "v1"]
+            # both replicas certified against the NEW version's golden
+            assert [r.integrity for r in pool.replicas] == ["ok", "ok"]
+            assert set(pool.weights_reference) == {"v1"}
+            assert set(pool._canary_goldens) == {"v1"}
+            assert pool.rollout_moves_total == 2
+            assert pool.rollout_aborts_total == 0
+            assert not pool._slot_versions
+            # a post-rollout completion on the new version is bit-identical
+            status, _, after = post_raw(url, body)
+            assert status == 200
+            assert after["choices"][0]["message"]["content"] == baseline
+            # /readyz schema (docs/OBSERVABILITY.md "Readiness schema")
+            code, raw = get(url, "/readyz")
+            assert code == 200
+            ready = json.loads(raw)
+            assert ready["weights_version"] == "v1"
+            assert ready["rollout"] == {"active": False}
+            for entry in ready["replicas"]:
+                assert entry["weights_version"] == "v1"
+                assert entry["cordoned"] is False
+                assert isinstance(entry["generation"], int)
+            # re-rolling to the version already served is a typed 409
+            status, resp = post_admin(url, {"version": "v1"})
+            assert status == 409
+            assert resp["error"]["type"] == "rollout_conflict"
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_corrupt_rebuild_trips_checksum_gate_and_rolls_back(
+        self, tmp_path
+    ):
+        """ISSUE 18 rollback criterion: a ``server.rollout kind=corrupt``
+        build perturbs replica 1's new-version weights before the
+        checksum gate — the rollout aborts typed, the pool converges
+        back to v0 on ALL replicas, the failed version leaves no golden
+        to flap against, and serving stays bit-identical throughout."""
+        faults.install(
+            faults.parse("server.rollout:kind=corrupt,row=1,count=1")
+        )
+        state = make_replica_state(
+            tmp_path, "rb", replicas=2, parallel=2, sdc_canary_tokens=4,
+        )
+        model = str(tmp_path / "rb.m")
+        state.register_weights_version(
+            "v1", lambda: InferenceEngine(model, dtype=jnp.float32)
+        )
+        url, server = serve_state(state)
+        try:
+            prompt, baseline = _one_long_prompt(url)
+            status, resp = post_admin(url, {"version": "v1"})
+            assert status == 500
+            assert resp["error"]["type"] == "rollout_aborted"
+            assert resp["rollout"] == {"active": False}
+            pool = state.pool
+            assert pool.weights_version == "v0"
+            assert [r.weights_version for r in pool.replicas] == ["v0", "v0"]
+            assert pool.rollout_aborts_total == 1
+            assert pool.rollout_moves_total == 1  # replica 0, before the gate
+            assert "v1" not in pool.weights_reference
+            assert "v1" not in pool._canary_goldens
+            # the checksum gate counted the corrupt build honestly...
+            mismatches = pool.sdc_mismatches_total
+            assert mismatches == 1
+            # ...and there is no mixed-version golden flap on top: the
+            # next canary pass certifies both rolled-back replicas
+            # against v0's golden cleanly
+            assert pool.canary_tick() == 2
+            assert pool.sdc_mismatches_total == mismatches
+            status, _, after = post_raw(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 96},
+            )
+            assert status == 200
+            assert after["choices"][0]["message"]["content"] == baseline
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+    def test_server_drain_mid_rollout_aborts_clean_permits_home(
+        self, tmp_path
+    ):
+        """Satellite: SIGTERM lands while replica 2-of-3 is mid-cutover
+        (a ``server.rollout kind=delay`` holds the window open) — the
+        rollout aborts typed WITHOUT rollback rebuilds, the in-flight
+        old-version stream ends bit-identically, and every admission
+        permit comes home inside the drain cap."""
+        faults.install(faults.parse(
+            _SLOW + ";server.rollout:kind=delay,row=1,delay_ms=1500,count=1"
+        ))
+        state = make_replica_state(
+            tmp_path, "dr", replicas=3, parallel=2, sdc_canary_tokens=4,
+        )
+        model = str(tmp_path / "dr.m")
+        state.register_weights_version(
+            "v1", lambda: InferenceEngine(model, dtype=jnp.float32)
+        )
+        url, server = serve_state(state)
+        try:
+            prompt, baseline = _one_long_prompt(url)
+            s = SseStream(
+                url, {"messages": [{"role": "user", "content": prompt}],
+                      "max_tokens": 96},
+            )
+            first = s.read_first_delta()
+            aborts = []
+            def run():
+                try:
+                    state.rollout.run("v1")
+                except fleet.RolloutAborted as e:
+                    aborts.append(e)
+            t = threading.Thread(target=run)
+            t.start()
+            # wait for move 1-of-3 to land, then SIGTERM inside move 2's
+            # held-open cutover window
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if state.pool.rollout_status().get("moved", 0) >= 1:
+                    break
+                time.sleep(0.01)
+            assert state.pool.rollout_status().get("moved", 0) >= 1
+            state.begin_drain()
+            t.join(timeout=120)
+            assert not t.is_alive()
+            assert len(aborts) == 1  # typed, no rollback rebuilds
+            got = first + s.read_rest()
+            assert got == baseline and s.done and s.error_type is None
+            assert state.admission.drain_wait(10.0)  # permits home
+            pool = state.pool
+            assert pool.rollout_status() == {"active": False}
+            assert pool.rollout_aborts_total == 1
+            assert pool.weights_version == "v0"
+            # mixed versions on the way down are harmless — every version
+            # still serving kept its own integrity anchors
+            versions = {r.weights_version for r in pool.replicas}
+            assert versions <= {"v0", "v1"} and "v0" in versions
+        finally:
+            server.shutdown()
+            state.pool.close()
+
+
+@pytest.mark.chaos
+class TestFleetElasticityServing:
+    def test_scale_up_serves_then_scale_down_returns_capacity(
+        self, tmp_path
+    ):
+        """ISSUE 18 elasticity criterion on real builds: sustained
+        pressure grows a third replica through the factory + checksum
+        gate, the grown replica serves real traffic, sustained idle
+        shrinks back, and admission capacity is exact throughout."""
+        state = make_replica_state(tmp_path, "el", replicas=2, parallel=2)
+        url, server = serve_state(state)
+        try:
+            ctl = fleet.FleetController(
+                state, min_replicas=2, max_replicas=3, queue_high=1,
+                up_ticks=2, down_ticks=2, drain_timeout_s=5.0,
+            )
+            adm = state.admission
+            assert adm.n_slots == 4
+            def push(n=2):
+                adm.rejected_total["load"] = (
+                    adm.rejected_total.get("load", 0) + n
+                )
+            push()
+            assert ctl.tick() is None  # hysteresis: streak 1 of 2
+            push()
+            assert ctl.tick() == "up"
+            pool = state.pool
+            assert len(pool.replicas) == 3 and adm.n_slots == 6
+            assert pool.replicas[2].weights_version == pool.weights_version
+            # the grown replica joins the serving set for real traffic
+            status, _, body = post_raw(
+                url, {"messages": [{"role": "user", "content": "hello"}],
+                      "max_tokens": 8},
+            )
+            assert status == 200
+            code, raw = get(url, "/readyz")
+            assert len(json.loads(raw)["replicas"]) == 3
+            # sustained idle shrinks back; capacity returns exactly
+            assert ctl.tick() is None
+            assert ctl.tick() == "down"
+            assert len(pool.replicas) == 2 and adm.n_slots == 4
+            assert ctl.scale_events == {"up": 1, "down": 1}
+            for _ in range(3):  # min bound holds
+                assert ctl.tick() is None
+            assert len(pool.replicas) == 2
+        finally:
+            server.shutdown()
+            state.pool.close()

@@ -305,7 +305,8 @@ class TestCanary:
         try:
             pool = state.pool
             assert pool.canary_probe is not None  # armed at ApiState build
-            assert pool.weights_reference is not None
+            # the version→checksum map holds the boot version's reference
+            assert pool.weights_reference.get(pool.weights_version)
             assert pool.canary_tick() == 2  # both replicas conclusive
             assert pool.canary_tick() == 2  # and again, against the golden
             assert pool.sdc_checks_total >= 4
@@ -369,7 +370,9 @@ class TestCanary:
         url, server = serve_state(state)
         try:
             pool = state.pool
-            reference = pool.weights_reference
+            # snapshot the boot version's checksum VALUE (the map itself
+            # mutates across rollouts)
+            reference = pool.weights_reference[pool.weights_version]
             # pin replica 1 so this phase's traffic lands on replica 0
             for s in pool.replicas[1].slots:
                 s.busy = True
@@ -443,7 +446,7 @@ class TestCanary:
             # (d) the rebuild passes checksum verification and re-enters
             assert pool.wait_state(0, HEALTHY, timeout_s=60)
             assert pool.restarts_total == 1
-            assert pool.weights_reference == reference
+            assert pool.weights_reference[pool.weights_version] == reference
             assert integrity.params_checksum(
                 pool.replicas[0].engine.params
             ) == reference
@@ -487,7 +490,7 @@ class TestCanary:
             assert pool.restarts_total == 1  # and only the CLEAN one entered
             assert integrity.params_checksum(
                 pool.replicas[0].engine.params
-            ) == pool.weights_reference
+            ) == pool.weights_reference[pool.weights_version]
         finally:
             pool.close()
 
