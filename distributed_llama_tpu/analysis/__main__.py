@@ -111,8 +111,11 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         findings, _ = analyze(paths, config, rules=rules, use_baseline=False)
         target = config.rel_to_root(config.baseline)
-        write_baseline(target, findings)
-        print(f"wrote {len(findings)} fingerprint(s) to {target}")
+        pruned = write_baseline(target, findings)
+        print(
+            f"wrote {len(findings)} fingerprint(s) to {target}"
+            f" ({pruned} stale fingerprint(s) pruned)"
+        )
         return 0
 
     findings, stats = analyze(

@@ -36,8 +36,16 @@ class AnalysisConfig:
     # TRC-001: the module whose top-level SPAN_NAMES tuple registers
     # trace span names
     span_registry: str = "distributed_llama_tpu/telemetry/spans.py"
-    # LCK-001/002: attribute names that count as "the scheduler lock"
+    # LCK-001/002: attribute names that count as "the scheduler lock".
+    # When `lock_ranks` is set (the `[tool.dllama.analysis.locks]` table)
+    # this is DERIVED from the declared lock names — the flat list only
+    # survives as an override for rank-less setups.
     lock_attrs: tuple[str, ...] = ("_cond",)
+    # LCK-003 / lockcheck: the declared lock hierarchy as ("Class._attr",
+    # rank) pairs — lower rank acquires first, leaf locks are max-rank.
+    # Committed once in pyproject's [tool.dllama.analysis.locks] table;
+    # both the static rule and the runtime witness read this.
+    lock_ranks: tuple[tuple[str, int], ...] = ()
     # CLK-001: "relpath" or "relpath::qualname-glob" entries where
     # time.time() is wall-clock-appropriate (API `created` fields)
     clock_allow: tuple[str, ...] = ()
@@ -47,6 +55,23 @@ class AnalysisConfig:
     # fnmatch globs of relpaths to skip entirely
     exclude: tuple[str, ...] = ()
     metric_prefix: str = "dllama_"
+
+    def __post_init__(self) -> None:
+        if self.lock_ranks:
+            # normalize (accept dicts / lists from loaders) and derive the
+            # flat attr list the lexical rules key on from the ranked names
+            pairs = dict(self.lock_ranks)
+            self.lock_ranks = tuple(
+                sorted((str(k), int(v)) for k, v in pairs.items())
+            )
+            derived = {k.rsplit(".", 1)[-1] for k, _ in self.lock_ranks}
+            self.lock_attrs = tuple(sorted(derived | set(self.lock_attrs)))
+
+    def rank_of(self, lock_id: str) -> int | None:
+        for key, rank in self.lock_ranks:
+            if key == lock_id:
+                return rank
+        return None
 
     def rel_to_root(self, path: str) -> str:
         return os.path.normpath(os.path.join(self.root, path))
@@ -82,10 +107,12 @@ def _parse_toml_section(text: str, section: str) -> dict:
             continue
         if not in_section or not line or line.startswith("#"):
             continue
-        m = re.match(r"([A-Za-z0-9_-]+)\s*=\s*(.*)$", line)
+        # keys may be bare or quoted — the locks table uses quoted
+        # "Class._attr" keys, which plain TOML requires to be strings
+        m = re.match(r'(?:"([^"]+)"|([A-Za-z0-9_.-]+))\s*=\s*(.*)$', line)
         if not m:
             continue
-        key, value = m.group(1), m.group(2).strip()
+        key, value = m.group(1) or m.group(2), m.group(3).strip()
         if value.startswith("["):
             # accumulate until the array's brackets balance
             while value.count("[") > value.count("]") and i < len(lines):
@@ -116,7 +143,11 @@ def _read_section(pyproject_path: str) -> dict:
         data = tomllib.loads(text)
         return data.get("tool", {}).get("dllama", {}).get("analysis", {})
     except ModuleNotFoundError:
-        return _parse_toml_section(text, "tool.dllama.analysis")
+        section = _parse_toml_section(text, "tool.dllama.analysis")
+        locks = _parse_toml_section(text, "tool.dllama.analysis.locks")
+        if locks:
+            section["locks"] = locks
+        return section
 
 
 def find_pyproject(start: str) -> str | None:
@@ -153,4 +184,9 @@ def load_config(start: str | None = None, pyproject: str | None = None) -> Analy
         if key in section:
             val = section[key]
             kwargs[key] = tuple(val) if typ is tuple else typ(val)
+    locks = section.get("locks")
+    if isinstance(locks, dict) and locks:
+        kwargs["lock_ranks"] = tuple(
+            sorted((str(k), int(v)) for k, v in locks.items())
+        )
     return AnalysisConfig(**kwargs)

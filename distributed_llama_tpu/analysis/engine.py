@@ -93,11 +93,29 @@ class FileCtx:
                     alias = a.asname or a.name
                     self.module_aliases.add(alias)
                     self.from_imports[alias] = (node.module, a.name)
+        # string-literal spans: noqa text INSIDE a string (docstrings
+        # quoting the syntax, generated-file headers) is prose, not a
+        # suppression — it must neither suppress findings nor trip GEN-002
+        str_spans = [
+            (n.lineno, n.col_offset, n.end_lineno, n.end_col_offset)
+            for n in ast.walk(self.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and n.end_lineno is not None
+        ]
+
+        def in_string(line: int, col: int) -> bool:
+            for l0, c0, l1, c1 in str_spans:
+                if (l0, c0) <= (line, col) and (line, col) < (l1, c1):
+                    return True
+            return False
+
         # line -> None (suppress all rules) | set of rule ids
         self.noqa: dict[int, set[str] | None] = {}
         for i, text in enumerate(self.lines, start=1):
             m = _NOQA_RE.search(text)
             if not m:
+                continue
+            if in_string(i, m.start()):
                 continue
             if m.group(1):
                 ids = {part.strip().upper() for part in m.group(1).split(",")}
@@ -236,6 +254,19 @@ class Rule:
     def finalize(self, project: ProjectContext) -> list[Finding]:
         return []
 
+    def post_suppression(
+        self,
+        project: ProjectContext,
+        active_ids: set[str],
+        used: set[tuple[str, int, str | None]],
+    ) -> list[Finding]:
+        """Hook run by the driver AFTER the noqa pass: ``used`` holds the
+        (rel, line, rule-id-or-None-for-bare) suppressions that actually
+        absorbed a finding. Findings returned here bypass inline noqa (but
+        not the baseline) — GEN-002 uses this to flag noqa comments that
+        suppressed nothing."""
+        return []
+
     def finding(
         self, fc: FileCtx, node: ast.AST, message: str, severity: str | None = None
     ) -> Finding:
@@ -272,7 +303,14 @@ def load_baseline(path: str) -> dict[str, int]:
     return counts
 
 
-def write_baseline(path: str, findings: list[Finding]) -> None:
+def write_baseline(path: str, findings: list[Finding]) -> int:
+    """Snapshot ``findings`` as the new baseline. Returns the number of
+    STALE fingerprints pruned — entries of the previous baseline that no
+    current finding matches (fixed code whose grandfather entry would
+    otherwise silently absorb a future regression)."""
+    old = load_baseline(path)
+    fresh = {f2.fingerprint() for f2 in findings}
+    pruned = sum(n for fp, n in old.items() if fp not in fresh)
     with open(path, "w", encoding="utf-8") as f:
         f.write(
             "# dllama-analyze baseline — grandfathered findings, one"
@@ -286,6 +324,7 @@ def write_baseline(path: str, findings: list[Finding]) -> None:
         )
         for fp in sorted(f2.fingerprint() for f2 in findings):
             f.write(fp + "\n")
+    return pruned
 
 
 def apply_baseline(
@@ -395,12 +434,24 @@ def analyze(
 
     kept: list[Finding] = []
     suppressed = 0
+    # which suppressions earned their keep: (rel, line, rule-id) for a
+    # scoped hit, (rel, line, None) when the bare form absorbed it
+    used: set[tuple[str, int, str | None]] = set()
     for f in raw:
         fc = project.by_rel.get(f.path)
         if fc is not None and fc.suppressed(f.rule, f.line):
             suppressed += 1
+            ids = fc.noqa.get(f.line)
+            used.add(
+                (f.path, f.line, None if ids is None else f.rule.upper())
+            )
         else:
             kept.append(f)
+
+    active_ids = {r.id for r in rules}
+    for rule in rules:
+        kept.extend(rule.post_suppression(project, active_ids, used))
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     baselined = 0
     if use_baseline and config.baseline:

@@ -8,18 +8,29 @@ from ..engine import Rule
 from .clock import WallClockRule
 from .donation import DonationRule
 from .exceptions import BaseExceptionRule
-from .locks import BlockingUnderLockRule, LockedCallRule
+from .falsy import FalsyDefaultRule
+from .hygiene import UselessNoqaRule
+from .locks import (
+    BlockingUnderLockRule,
+    LockedCallRule,
+    LockOrderRule,
+    SharedStateMutationRule,
+)
 from .registries import FaultSiteRule, MetricNameRule, SpanNameRule
 
 _RULE_CLASSES = (
     DonationRule,       # DON-001
     LockedCallRule,     # LCK-001
     BlockingUnderLockRule,  # LCK-002
+    LockOrderRule,      # LCK-003
+    SharedStateMutationRule,  # LCK-004
     BaseExceptionRule,  # EXC-001
     WallClockRule,      # CLK-001
+    FalsyDefaultRule,   # FLS-001
     MetricNameRule,     # TEL-001
     FaultSiteRule,      # FLT-001
     SpanNameRule,       # TRC-001
+    UselessNoqaRule,    # GEN-002
 )
 
 

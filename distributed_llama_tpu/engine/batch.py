@@ -81,7 +81,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_llama_tpu import retry
+from distributed_llama_tpu import lockcheck, retry
 from distributed_llama_tpu.engine import faults, integrity
 from distributed_llama_tpu.engine.engine import TokenStats, _prefill_bucket, next_pow2
 from distributed_llama_tpu.engine.speculative import PromptLookupDrafter
@@ -583,7 +583,9 @@ class BatchScheduler:
         # lock RELEASED between dispatches, so decode chunks for other rows
         # interleave instead of stalling behind the whole prompt. 0 = one
         # monolithic dispatch (the pre-ISSUE-4 behavior).
-        self.prefill_chunk = max(0, int(prefill_chunk or 0))
+        self.prefill_chunk = max(
+            0, 0 if prefill_chunk is None else int(prefill_chunk)
+        )
         # radix-tree prefix cache over pool pages (ISSUE 4 tentpole, ISSUE 7
         # zero-copy): an admission prefill binds published KV pages to the
         # row's page table (attention reads them straight out of the pool)
@@ -753,7 +755,7 @@ class BatchScheduler:
             if tp_engine is not None else 1
         )
         self._streams: list[BatchStream] = []
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("BatchScheduler._cond")
         # one dispatched-but-unfetched chunk at a time: (tokens_dev, epoch
         # snapshot, bucket, active count, stopwatch)
         self._pending = None
@@ -776,7 +778,9 @@ class BatchScheduler:
     def close(self) -> None:
         """Stop the watchdog thread (tests; a serving scheduler lives for
         the process)."""
-        self._shutdown = True
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
 
     # ------------------------------------------------------------------
     # Replica loss (ISSUE 9): the whole-scheduler failure domain. A crash
@@ -1145,7 +1149,10 @@ class BatchScheduler:
 
         def upload(pid, arrays):
             with self.engine._tel.span("prefix_spill_reload", page=int(pid)):
-                self._pool = _upload_page(
+                # the closure runs SYNCHRONOUSLY inside prefix.reload,
+                # still under _reload_spilled_locked's cond — the AST
+                # can't see through the callback boundary
+                self._pool = _upload_page(  # dllama: noqa[LCK-004]
                     self._pool, jnp.int32(pid), self._page_pytree(arrays)
                 )
 

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_llama_tpu import prng, telemetry
+from distributed_llama_tpu import lockcheck, prng, telemetry
 from distributed_llama_tpu.engine import faults
 from distributed_llama_tpu.engine import weights as weights_lib
 from distributed_llama_tpu.telemetry import Stopwatch
@@ -971,14 +971,17 @@ class InferenceEngine:
         # once-per-engine "--spec-draft ignored" diagnostic latch (the spec
         # route is single-chip dense only; see EngineStream.stream_decode)
         self._spec_fallback_warned = False
-        self._transfer_ms: float | None = None  # measured lazily under TP/SP
-        self._transfer_measured_at = 0  # token count at the last measurement
+        # measured lazily under TP/SP; _init_runtime runs from the
+        # constructors BEFORE the engine is published to other threads
+        # (the _depth_lock guarding these is itself created 6 lines down)
+        self._transfer_ms: float | None = None  # dllama: noqa[LCK-004]
+        self._transfer_measured_at = 0  # dllama: noqa[LCK-004]
         self._pipeline_depth = 0  # >0 while a speculative chunk is in flight
         # concurrent streams (API --parallel) bump the depth from several
         # threads; the counter must not lose updates or go negative (a stuck
         # >0 would freeze the transfer estimate, a negative one would let
         # probes run mid-flight)
-        self._depth_lock = threading.Lock()
+        self._depth_lock = lockcheck.make_lock("InferenceEngine._depth_lock")
         # mesh-topology gauges (ISSUE 15): axis -> device count of the
         # backend's named mesh, so an operator can read the serving shape
         # off /metrics (the pod group additionally reports weight bytes)

@@ -147,6 +147,8 @@ import random
 import threading
 import time
 
+from distributed_llama_tpu import lockcheck
+
 
 class InjectedFault(RuntimeError):
     """Raised at an injection site by a ``kind=raise`` rule."""
@@ -287,7 +289,7 @@ class FaultPlan:
     def __init__(self, rules, seed: int = 0):
         self.rules: list[FaultRule] = list(rules)
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("FaultPlan._lock")
         self._hits: dict[str, int] = {}
         self._fired: dict[int, int] = {}
         self._rng = random.Random(self.seed)

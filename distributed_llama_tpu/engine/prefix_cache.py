@@ -76,7 +76,7 @@ from __future__ import annotations
 
 import threading
 
-from distributed_llama_tpu import telemetry
+from distributed_llama_tpu import lockcheck, telemetry
 from distributed_llama_tpu.engine.spill import SpillCorrupt
 from distributed_llama_tpu.telemetry import flight
 
@@ -95,7 +95,7 @@ class SharedPrefixIndex:
 
     def __init__(self, page: int):
         self.page = int(page)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("SharedPrefixIndex._lock")
         self._owners: dict[tuple, set[int]] = {}
 
     def publish(self, owner: int, chain: tuple) -> None:

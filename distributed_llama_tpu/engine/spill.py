@@ -48,7 +48,7 @@ import zlib
 
 import numpy as np
 
-from distributed_llama_tpu import telemetry
+from distributed_llama_tpu import lockcheck, telemetry
 
 
 class SpillCorrupt(RuntimeError):
@@ -201,7 +201,7 @@ class HostArena:
             DiskTier(disk_path, disk_budget_bytes, on_drop=self._on_disk_drop_locked)
             if disk_path and disk_budget_bytes > 0 else None
         )
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("HostArena._lock")
         self._entries: dict[tuple, _Entry] = {}
         # chain -> owners with a resident entry (host OR disk): the
         # cross-replica peek and the corrupt-chaos hook look up by chain

@@ -37,6 +37,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_llama_tpu import lockcheck
 from distributed_llama_tpu.models import llama
 from distributed_llama_tpu.models.config import LlamaConfig
 from distributed_llama_tpu.parallel import sharding
@@ -312,9 +313,7 @@ class TensorParallelForward(TransferProbeMixin):
         self._chunk_cache: dict = {}
         # serializes program ENQUEUE order across callers sharing this
         # backend (the pod's slice schedulers); see TransferProbeMixin._enqueue
-        import threading as _threading
-
-        self._dispatch_lock = _threading.Lock()
+        self._dispatch_lock = lockcheck.make_lock("TransferProbeMixin._dispatch_lock")
         axes = {"model": axis}
         if quantized:
             self._specs = q40_param_specs(

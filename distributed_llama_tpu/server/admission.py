@@ -42,6 +42,7 @@ import dataclasses
 import threading
 import time
 
+from distributed_llama_tpu import lockcheck
 from distributed_llama_tpu.engine.faults import DeadlineExceeded
 
 DEFAULT_TENANT = "default"
@@ -139,7 +140,7 @@ class FairAdmission:
         # Names past the cap fold into the shared DEFAULT_TENANT bucket
         # (still served, weight 1) instead of registering.
         self.max_tenants = max(1, int(max_tenants))
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("FairAdmission._cond")
         self._free = n_slots
         self._tenants: dict[str, TenantConfig] = dict(tenants or {})
         # registration order = the deterministic DRR tie-break order

@@ -57,7 +57,7 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from distributed_llama_tpu import retry, telemetry
+from distributed_llama_tpu import lockcheck, retry, telemetry
 from distributed_llama_tpu.engine import faults, integrity
 from distributed_llama_tpu.engine.faults import DeadlineExceeded
 from distributed_llama_tpu.server.admission import (
@@ -418,7 +418,7 @@ class ApiState:
         # fleet concurrently. Elasticity is opt-in: with no
         # --fleet-max-replicas the ceiling IS the boot count, and with
         # no --fleet-interval-s the controller only ticks manually.
-        self._fleet_lock = threading.Lock()
+        self._fleet_lock = lockcheck.make_lock("ApiState._fleet_lock")
         drain_s = getattr(args, "rollout_drain_s", None)
         self.rollout = fleet.RolloutOrchestrator(
             self,

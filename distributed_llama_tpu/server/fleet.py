@@ -63,6 +63,7 @@ from __future__ import annotations
 import threading
 import time
 
+from distributed_llama_tpu import lockcheck
 from distributed_llama_tpu.engine import faults, integrity
 from distributed_llama_tpu.server import replicas
 from distributed_llama_tpu.telemetry import flight
@@ -110,7 +111,7 @@ class RolloutOrchestrator:
         self.drain_timeout_s = float(drain_timeout_s)
         self.rebuild_timeout_s = float(rebuild_timeout_s)
         self.certify_attempts = max(1, int(certify_attempts))
-        self._ops = ops_lock if ops_lock is not None else threading.Lock()
+        self._ops = ops_lock if ops_lock is not None else lockcheck.make_lock("RolloutOrchestrator._ops")
         # bind-once like every other chaos consumer: the plan is
         # installed before the server is constructed
         self._faults = faults.active_plan()
@@ -441,14 +442,14 @@ class FleetController:
         self.up_ticks = max(1, int(up_ticks))
         self.down_ticks = max(1, int(down_ticks))
         self.drain_timeout_s = float(drain_timeout_s)
-        self._ops = ops_lock if ops_lock is not None else threading.Lock()
+        self._ops = ops_lock if ops_lock is not None else lockcheck.make_lock("FleetController._ops")
         self._up_streak = 0
         self._down_streak = 0
         self._last_rejected = 0
         # plain ledger, readable with telemetry off (mirrors
         # dllama_fleet_scale_events_total{direction})
         self.scale_events = {"up": 0, "down": 0}
-        self.interval_s = float(interval_s or 0.0)
+        self.interval_s = 0.0 if interval_s is None else float(interval_s)
         self._thread: threading.Thread | None = None
         if self.interval_s > 0:
             self._thread = threading.Thread(
@@ -475,16 +476,19 @@ class FleetController:
         rollout holds the ops lock — elasticity never fights a
         rollout."""
         pool = self.state.pool
-        if (
-            pool._closed
-            or getattr(self.state, "draining", False)
-            or pool.rollout is not None
-        ):
-            self._up_streak = self._down_streak = 0
-            return None
         if not self._ops.acquire(blocking=False):
             return None
         try:
+            if (
+                pool._closed
+                or getattr(self.state, "draining", False)
+                or pool.rollout is not None
+            ):
+                # a down/draining/rolling fleet invalidates accumulated
+                # evidence; the reset happens under _ops — the same lock
+                # _tick_locked mutates the streak counters under
+                self._up_streak = self._down_streak = 0
+                return None
             # _ops IS held: acquired non-blocking above so elasticity
             # skips the tick instead of queueing behind a rollout.
             return self._tick_locked(pool)  # dllama: noqa[LCK-001]

@@ -32,10 +32,14 @@ engine, its slab, its prefix-cache pool and its serving lanes.
   lockstep, recreating the thundering herd (the ISSUE 8 Retry-After
   lesson, applied to supervision).
 
-Lock discipline: the pool's ``_cond`` is a LEAF lock. Scheduler health
-hooks call into the pool while holding the scheduler's cond, so nothing
-here may call back into a scheduler while holding ``_cond`` (the preempt
-fan-out snapshots the scheduler list first, then calls unlocked).
+Lock discipline: ``ReplicaPool._cond`` ranks ABOVE the schedulers' conds
+in the declared hierarchy (pyproject ``[tool.dllama.analysis.locks]``;
+docs/ROBUSTNESS.md "Lock hierarchy"), so scheduler health hooks may call
+into the pool while holding a scheduler cond, but nothing here may call
+back into a scheduler while holding ``_cond`` (the preempt fan-out
+snapshots the scheduler list first, then calls unlocked). The contract is
+machine-checked: statically by LCK-003, dynamically by the
+``DLT_LOCK_CHECK=1`` witness (distributed_llama_tpu/lockcheck.py).
 
 Everything is testable in-process under ``JAX_PLATFORMS=cpu``: replicas
 are ordinary schedulers over tiny synthetic models, and the chaos sites
@@ -50,7 +54,7 @@ import random
 import threading
 import time
 
-from distributed_llama_tpu import retry
+from distributed_llama_tpu import lockcheck, retry
 from distributed_llama_tpu.engine import faults, integrity
 from distributed_llama_tpu.telemetry import Stopwatch, flight
 
@@ -163,7 +167,7 @@ class ReplicaPool:
             random.Random(restart_seed) if restart_seed is not None
             else random.Random()
         )
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("ReplicaPool._cond")
         self._closed = False
         # plain ledger, readable with telemetry off (the registry metrics
         # mirror these; tests and the loadgen report read them directly)
@@ -459,7 +463,9 @@ class ReplicaPool:
         only (tests, and the shadow-vote path which reuses the probe)."""
         self.canary_probe = probe
         self.canary_fail_threshold = max(1, int(fail_threshold))
-        self.canary_interval_s = float(interval_s or 0.0)
+        self.canary_interval_s = (
+            0.0 if interval_s is None else float(interval_s)
+        )
         if self.canary_interval_s > 0 and self._canary_thread is None:
             self._canary_thread = threading.Thread(
                 target=self._canary_loop, name="dllama-sdc-canary",

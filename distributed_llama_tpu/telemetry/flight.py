@@ -31,6 +31,8 @@ import os
 import threading
 import time
 
+from distributed_llama_tpu import lockcheck
+
 # unattributed events (a fault fire with no row/replica context) land here
 UNSCOPED = -1
 
@@ -48,7 +50,7 @@ class FlightRecorder:
         self.capacity = max(1, int(capacity))
         self.max_dumps = max(1, int(max_dumps))
         self.dump_dir = dump_dir
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("FlightRecorder._lock")
         self._epoch = time.perf_counter()
         self._rings: dict[int, collections.deque] = {}
         self._dumps: collections.deque = collections.deque(maxlen=self.max_dumps)

@@ -1,0 +1,16 @@
+"""FLS-001 bad fixture: the PR 3 / PR 9 falsy-default bug — numeric
+parameters defaulted with truthiness, so an explicit, meaningful ``0``
+(unbounded queue, suspect-immediately, no-chunking) silently becomes the
+default."""
+
+
+def start(timeout=None, retries=None):
+    t = timeout or 5.0  # FLS-001: `--timeout 0` becomes 5.0
+    r = retries if retries else 3  # FLS-001: the ternary spelling
+    return t, r
+
+
+class Controller:
+    def __init__(self, interval_s=None):
+        # FLS-001: interval_s=0 ("tick as fast as possible") becomes 30s
+        self.interval_s = interval_s or 30.0

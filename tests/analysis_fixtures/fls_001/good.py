@@ -1,0 +1,26 @@
+"""FLS-001 good fixture: the fixed forms — ``is None`` defaulting keeps
+an explicit 0 meaningful; object-valued fallbacks and non-parameter names
+stay legal (for those, falsiness and missingness coincide)."""
+
+DEBUG = 0
+
+
+class Policy:
+    pass
+
+
+def start(timeout=None, retries=None, policy=None):
+    t = 5.0 if timeout is None else timeout
+    r = 3 if retries is None else retries
+    p = policy or Policy()  # object default: falsy == missing, legal
+    return t, r, p
+
+
+def level():
+    verbosity = DEBUG
+    return verbosity or 1  # not a parameter: outside the bug class
+
+
+class Controller:
+    def __init__(self, interval_s=None):
+        self.interval_s = 30.0 if interval_s is None else float(interval_s)

@@ -1,0 +1,19 @@
+"""GEN-002 bad fixture: suppressions that suppress nothing — a scoped
+noqa left behind after its violation was fixed, a bare noqa absorbing
+nothing, and a typo'd rule id that could never suppress anything."""
+
+import time
+
+
+def tick():
+    # the violation was fixed (monotonic, not wall-clock) but the comment
+    # stayed behind, holding a hole open
+    return time.monotonic()  # dllama: noqa[CLK-001]
+
+
+def idle():
+    return 1  # dllama: noqa
+
+
+def stale():
+    return 2  # dllama: noqa[OLD-999]

@@ -1,0 +1,15 @@
+"""GEN-002 good fixture: every suppression absorbs a real finding — the
+scoped noqa sits on a live CLK-001 hit, the bare noqa on another, and a
+deliberate placeholder opts out with ``noqa[GEN-002]``."""
+
+import time
+
+
+def stamp():
+    # a deliberate user-facing wall-clock read, grandfathered rule-scoped
+    return time.time()  # dllama: noqa[CLK-001]
+
+
+def stamp_pair():
+    # a bare noqa is useless-checked too — this one absorbs the hit
+    return time.time(), 0  # dllama: noqa

@@ -1,0 +1,38 @@
+"""LCK-003 bad fixture: the PR 15 enqueue-deadlock shape — the pool's
+lock (rank 40) held while the scheduler's lock (rank 20) is acquired,
+once by direct nesting and once through a method call the rule resolves
+interprocedurally. Two threads taking the two locks in opposite orders
+is exactly the deadlock the CPU mocks surfaced."""
+
+import threading
+
+
+class Sched:
+    """Declared rank 20 in the fixture's rank table."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.pool = None
+
+    def enqueue(self):
+        with self._cond:
+            return True
+
+
+class Pool:
+    """Declared rank 40 — the leaf: nothing may be acquired under it."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.sched = None
+
+    def on_replica_dead(self):
+        sched = self.sched
+        with self._cond:  # rank 40 held...
+            with sched._cond:  # LCK-003: ...rank 20 acquired under it
+                pass
+
+    def kill_replica(self):
+        sched = self.sched
+        with self._cond:  # rank 40 held...
+            sched.enqueue()  # LCK-003: reaches Sched._cond (rank 20)

@@ -1,0 +1,41 @@
+"""LCK-003 good fixture: the shipped discipline — acquisitions strictly
+ascend the declared ranks (scheduler rank 20 before pool rank 40), and
+calls that would re-enter a lower-ranked lock happen AFTER the leaf lock
+is released (snapshot under the lock, act unlocked — the replicas.py
+preempt fan-out shape)."""
+
+import threading
+
+
+class Sched:
+    """Rank 20: acquired first on any path that also touches the pool."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.pool = None
+
+    def enqueue(self):
+        with self._cond:
+            return True
+
+    def dispatch(self):
+        pool = self.pool
+        with self._cond:  # rank 20...
+            with pool._cond:  # ...then rank 40: strictly ascending
+                pass
+
+
+class Pool:
+    """Rank 40 — the leaf lock."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.sched = None
+
+    def kill_replica(self):
+        sched = self.sched
+        with self._cond:  # snapshot the victims under the leaf lock
+            victims = list(range(3))
+        # ...then call back into the scheduler UNLOCKED: no edge exists
+        for _ in victims:
+            sched.enqueue()

@@ -1,0 +1,25 @@
+"""LCK-004 bad fixture: the PR 9 ``replayed_total`` lost-update — an
+attribute mutated under the lock on the requeue path and bare-incremented
+on the replay path. Two replaying threads read-modify-write the bare site
+concurrently and one increment vanishes; the OBSERVABILITY.md health read
+(replays vs victim count) then lies."""
+
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replayed_total = 0
+        self.victims = 0
+
+    def requeue(self, n):
+        with self._lock:
+            self.replayed_total += n
+            self.victims += 1
+
+    def replay_one(self):
+        self.replayed_total += 1  # LCK-004: unlocked increment
+
+    def reset_window(self):
+        self.victims = 0  # LCK-004: unlocked rebind of a locked attr

@@ -1,0 +1,26 @@
+"""LCK-004 good fixture: the fixed forms — every mutation of a
+lock-guarded attribute happens under the lock; ``__init__`` stays exempt
+(construction happens-before publication), and an attribute that is never
+locked anywhere in its class is outside the rule's contract."""
+
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replayed_total = 0  # construction: exempt by design
+        self.last_seen = None
+
+    def requeue(self, n):
+        with self._lock:
+            self.replayed_total += n
+
+    def replay_one(self):
+        with self._lock:
+            self.replayed_total += 1  # fixed: same lock as requeue
+
+    def note(self, t):
+        # never mutated under a lock anywhere in the class: single-writer
+        # state outside the rule's contract
+        self.last_seen = t

@@ -25,3 +25,23 @@ jax.config.update("jax_platforms", "cpu")
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture
+def lock_witness():
+    """Arm the runtime lock-order witness (distributed_llama_tpu/lockcheck)
+    for one test: locks CONSTRUCTED inside the test get witness wrappers
+    checking the pyproject [tool.dllama.analysis.locks] hierarchy, and the
+    violation ledger is clean on entry and restored on exit. Chaos tests
+    opt in with this fixture (or export DLT_LOCK_CHECK=1, as CI does)."""
+    from distributed_llama_tpu import lockcheck
+
+    lockcheck.configure(mode="raise")
+    lockcheck.reset()
+    try:
+        yield lockcheck
+    finally:
+        lockcheck.configure()
+        lockcheck.reset()

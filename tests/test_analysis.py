@@ -38,7 +38,11 @@ def fixture(sub: str, *names: str) -> list[str]:
 
 
 def run_rule(rule_id: str, files: list[str], cfg: AnalysisConfig):
-    findings, stats = analyze(files, cfg, rules=all_rules({rule_id}))
+    # GEN-002 judges the OTHER rules' suppressions, so its fixture case
+    # must run the full rule set (a partial --select can't prove a bare
+    # noqa useless — by design)
+    select = None if rule_id == "GEN-002" else {rule_id}
+    findings, stats = analyze(files, cfg, rules=all_rules(select))
     return findings, stats
 
 
@@ -57,6 +61,11 @@ CASES = [
     ("DON-001", "don_001", 4, (), {}),
     ("LCK-001", "lck_001", 3, (), {}),
     ("LCK-002", "lck_002", 4, (), {}),
+    ("LCK-003", "lck_003", 2, (),
+     {"lock_ranks": (("Sched._cond", 20), ("Pool._cond", 40))}),
+    ("LCK-004", "lck_004", 2, (), {"lock_attrs": ("_lock",)}),
+    ("FLS-001", "fls_001", 3, (), {}),
+    ("GEN-002", "gen_002", 3, (), {}),
     ("EXC-001", "exc_001", 2, (), {}),
     ("CLK-001", "clk_001", 4, (), {}),
     ("TEL-001", "tel_001", 3, (), {"observability_doc": "doc.md"}),
@@ -197,6 +206,63 @@ def test_trc_001_name_literal_in_second_position(tmp_path):
     assert len(findings) == 1 and "bad_one" in findings[0].message
 
 
+def test_lck_003_reconstructs_pr15_deadlock():
+    """The PR 15 shape: pool lock held while the scheduler lock is taken,
+    directly and through a resolved method call."""
+    kw = {"lock_ranks": (("Sched._cond", 20), ("Pool._cond", 40))}
+    cfg = cfg_for("lck_003", **kw)
+    findings, _ = run_rule("LCK-003", fixture("lck_003", "bad.py"), cfg)
+    assert len(findings) == 2
+    assert all("Sched._cond" in f.message and "Pool._cond" in f.message
+               for f in findings)
+    # one edge is interprocedural and names its call chain
+    assert any("via Sched.enqueue" in f.message for f in findings)
+
+
+def test_lck_004_reconstructs_pr9_lost_update():
+    cfg = cfg_for("lck_004", lock_attrs=("_lock",))
+    findings, _ = run_rule("LCK-004", fixture("lck_004", "bad.py"), cfg)
+    assert {f.message.split("`")[1] for f in findings} == {
+        "self.replayed_total", "self.victims",
+    }
+    assert all("replayed_total" in f.message or "lock" in f.message
+               for f in findings)
+
+
+def test_gen_002_optout_and_partial_scan(tmp_path):
+    """`noqa[GEN-002]` opts a line out, and a partial --select run never
+    judges a bare noqa (it can't prove the blanket useless)."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "def a():\n    return 1  # dllama: noqa[GEN-002]\n\n\n"
+        "def b():\n    return 2  # dllama: noqa\n"
+    )
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="")
+    findings, _ = analyze([str(f)], cfg, rules=all_rules(None))
+    # the opted-out line is silent; the bare noqa on b() is flagged
+    assert len(findings) == 1 and findings[0].line == 6
+    # partial scan: the same bare noqa is not judged
+    findings2, _ = analyze(
+        [str(f)], cfg, rules=all_rules({"CLK-001", "GEN-002"})
+    )
+    assert findings2 == []
+
+
+def test_noqa_text_inside_a_string_is_not_a_suppression(tmp_path):
+    """Doc prose mentioning the noqa syntax must neither suppress findings
+    nor count as a useless comment (the GEN-002 dogfood regression)."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n\n\ndef handler():\n"
+        "    return time.time(), '# dllama: noqa[CLK-001]'\n"
+    )
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="")
+    findings, _ = analyze([str(f)], cfg, rules=all_rules(None))
+    # the string is not a suppression: CLK-001 fires, GEN-002 stays quiet
+    assert [f2.rule for f2 in findings] == ["CLK-001"]
+    assert findings[0].line == 5
+
+
 def test_span_registry_matches_shipped_names():
     """SPAN_NAMES and the shipped call sites agree — TRC-001's source of
     truth enumerates the whole trace surface (mirrors the faults.SITES
@@ -295,6 +361,34 @@ def test_baseline_roundtrip(tmp_path):
     assert len(findings3) == 1 and findings3[0].qualname == "fresh"
 
 
+def test_write_baseline_prunes_stale_fingerprints(tmp_path):
+    """Re-writing the baseline drops fingerprints whose findings are gone
+    and reports how many it pruned."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n\n\ndef handler():\n    return time.time()\n"
+        "\n\ndef other():\n    return time.time()\n"
+    )
+    cfg = AnalysisConfig(root=str(tmp_path), baseline="bl.txt")
+    findings, _ = run_rule("CLK-001", [str(f)], cfg)
+    assert len(findings) == 2
+    bl = str(tmp_path / "bl.txt")
+    assert write_baseline(bl, findings) == 0
+
+    # fix one site: its fingerprint is stale and gets pruned
+    f.write_text(
+        "import time\n\n\ndef handler():\n    return time.time()\n"
+        "\n\ndef other():\n    return time.monotonic()\n"
+    )
+    findings2, _ = analyze(
+        [str(f)], cfg, rules=all_rules({"CLK-001"}), use_baseline=False
+    )
+    assert len(findings2) == 1
+    assert write_baseline(bl, findings2) == 1
+    findings3, stats3 = run_rule("CLK-001", [str(f)], cfg)
+    assert findings3 == [] and stats3["baselined"] == 1
+
+
 def test_parse_failure_is_a_finding_not_a_crash(tmp_path):
     f = tmp_path / "broken.py"
     f.write_text("def oops(:\n")
@@ -308,6 +402,14 @@ def test_repo_config_loads():
     assert cfg.root == REPO
     assert cfg.baseline == "analysis-baseline.txt"
     assert "_cond" in cfg.lock_attrs and "_depth_lock" in cfg.lock_attrs
+    # the declared hierarchy loads, ascends leaf-ward, and agrees with the
+    # runtime witness's view of the same table
+    ranks = dict(cfg.lock_ranks)
+    assert ranks["ApiState._fleet_lock"] < ranks["BatchScheduler._cond"]
+    assert ranks["BatchScheduler._cond"] < ranks["ReplicaPool._cond"]
+    assert ranks["ReplicaPool._cond"] < ranks["FlightRecorder._lock"]
+    assert cfg.rank_of("FlightRecorder._lock") == ranks["FlightRecorder._lock"]
+    assert cfg.rank_of("Nope._lock") is None
     assert cfg.fault_registry == "distributed_llama_tpu/engine/faults.py"
     assert cfg.span_registry == "distributed_llama_tpu/telemetry/spans.py"
     assert any("api.py" in entry for entry in cfg.clock_allow)
@@ -333,6 +435,21 @@ def test_mini_toml_parser_subset():
     assert section["baseline"] == "bl.txt"
     assert section["lock_attrs"] == ["_cond", "_depth_lock"]
     assert section["metric_prefix"] == "dllama_"
+
+
+def test_mini_toml_parser_quoted_keys_and_locks_table():
+    text = textwrap.dedent(
+        """
+        [tool.dllama.analysis]
+        baseline = "bl.txt"
+
+        [tool.dllama.analysis.locks]
+        "Sched._cond" = 20  # the scheduler lock
+        "Pool._cond" = 40
+        """
+    )
+    locks = _parse_toml_section(text, "tool.dllama.analysis.locks")
+    assert locks == {"Sched._cond": 20, "Pool._cond": 40}
 
 
 def test_fault_registry_matches_shipped_sites():
